@@ -1,0 +1,67 @@
+"""Benchmark: diamonds-shaped GBDT training throughput on one TPU chip.
+
+Reference baseline (BASELINE.md): LightGBM trains 200 rounds on the diamonds
+workload (~45.9k rows x 6 features, num_leaves=31) in 1.02 s elapsed on a
+2017 laptop CPU -> ~9.0M row-rounds/s.  This benchmark times the same-shape
+training (synthetic diamonds standing in for the unfetchable ggplot2 data)
+on one TPU chip, excluding the one-time XLA compile (the reference's 1.02s
+also excludes R package load / dataset construction).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils.datasets import (
+        make_synthetic_diamonds,
+        train_test_split_bernoulli,
+    )
+
+    X, y, _ = make_synthetic_diamonds()
+    tr, te = train_test_split_bernoulli(len(y), 0.85, seed=3928272)
+    Xtr, ytr = X[tr], y[tr]
+    n_rounds = 200
+    params = {"learning_rate": 0.1, "objective": "regression",
+              "verbosity": 0, "num_leaves": 31}
+
+    dtrain = lgb.Dataset(Xtr, label=ytr)
+    dtrain.construct()
+
+    # warmup: compile the round step + staging (3 rounds)
+    lgb.train(params, dtrain, num_boost_round=3)
+
+    t0 = time.perf_counter()
+    booster = lgb.train(params, dtrain, num_boost_round=n_rounds)
+    # force completion of the async dispatch queue
+    import jax
+    jax.block_until_ready(booster._pred_train)
+    elapsed = time.perf_counter() - t0
+
+    # sanity: model quality must beat a linear fit (quality ladder, SURVEY §4)
+    from sklearn.linear_model import LinearRegression
+
+    pred = booster.predict(X[te])
+    gbdt_rmse = float(np.sqrt(np.mean((y[te] - pred) ** 2)))
+    lin = LinearRegression().fit(Xtr, ytr)
+    lin_rmse = float(np.sqrt(np.mean((y[te] - lin.predict(X[te])) ** 2)))
+    assert gbdt_rmse < lin_rmse, (gbdt_rmse, lin_rmse)
+
+    row_rounds_per_s = len(Xtr) * n_rounds / elapsed
+    baseline = 45_900 * 200 / 1.02  # reference: 1.02 s elapsed (BASELINE.md)
+    print(json.dumps({
+        "metric": "diamonds_train_row_rounds_per_s",
+        "value": round(row_rounds_per_s, 1),
+        "unit": "row*rounds/s (200 rounds, 45.9k rows, num_leaves=31)",
+        "vs_baseline": round(row_rounds_per_s / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,466 @@
+"""Parameter schema for lightgbm_tpu.
+
+Speaks LightGBM's parameter vocabulary (names, aliases, defaults) so that the
+reference snippets' param dicts work verbatim — see the contract extracted in
+SURVEY.md §2B from /root/reference/r/gridsearchCV.R:92-100 (grid passes
+``learning_rate``, ``num_leaves``, ``min_data_in_leaf``, ``feature_fraction``,
+``bagging_fraction``, ``bagging_freq``, ``nthread`` straight through params) and
+LightGBM R.ipynb:350-355 / 432-441 (``objective``, ``nrounds``, ``eval``,
+``early_stopping_rounds``, ``verbose``).
+
+Unknown parameters are tolerated with a warning (the reference rides ``nthread``
+inside params and LightGBM silently accepts it).
+
+Dynamic (trace-safe) vs static params: fields that only scale arithmetic
+(learning_rate, lambda_l1/l2, min_data_in_leaf, fractions, ...) are kept as
+Python floats here but may be fed to jitted code as traced scalars, enabling
+vmap over hyper-parameter configs.  Shape-determining fields (num_leaves,
+max_bin, num_iterations) are static.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+# ---------------------------------------------------------------------------
+# Alias table (LightGBM's Config::ParameterAlias, re-derived from the public
+# parameter docs — only the names plausibly reachable from the reference
+# snippets and sklearn-style wrappers).
+# ---------------------------------------------------------------------------
+_ALIASES: Dict[str, str] = {
+    # core
+    "num_iterations": "num_iterations",
+    "num_iteration": "num_iterations",
+    "n_iter": "num_iterations",
+    "num_tree": "num_iterations",
+    "num_trees": "num_iterations",
+    "num_round": "num_iterations",
+    "num_rounds": "num_iterations",
+    "nrounds": "num_iterations",
+    "num_boost_round": "num_iterations",
+    "n_estimators": "num_iterations",
+    "max_iter": "num_iterations",
+    "learning_rate": "learning_rate",
+    "shrinkage_rate": "learning_rate",
+    "eta": "learning_rate",
+    "num_leaves": "num_leaves",
+    "num_leaf": "num_leaves",
+    "max_leaves": "num_leaves",
+    "max_leaf": "num_leaves",
+    "max_leaf_nodes": "num_leaves",
+    "objective": "objective",
+    "objective_type": "objective",
+    "app": "objective",
+    "application": "objective",
+    "loss": "objective",
+    "boosting": "boosting",
+    "boosting_type": "boosting",
+    "boost": "boosting",
+    "max_depth": "max_depth",
+    "tree_learner": "tree_learner",
+    "tree": "tree_learner",
+    "tree_type": "tree_learner",
+    "tree_learner_type": "tree_learner",
+    "num_threads": "num_threads",
+    "num_thread": "num_threads",
+    "nthread": "num_threads",
+    "nthreads": "num_threads",
+    "n_jobs": "num_threads",
+    "device_type": "device_type",
+    "device": "device_type",
+    "seed": "seed",
+    "random_seed": "seed",
+    "random_state": "seed",
+    "deterministic": "deterministic",
+    # learning control
+    "min_data_in_leaf": "min_data_in_leaf",
+    "min_data_per_leaf": "min_data_in_leaf",
+    "min_data": "min_data_in_leaf",
+    "min_child_samples": "min_data_in_leaf",
+    "min_samples_leaf": "min_data_in_leaf",
+    "min_sum_hessian_in_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf",
+    "min_hessian": "min_sum_hessian_in_leaf",
+    "min_child_weight": "min_sum_hessian_in_leaf",
+    "bagging_fraction": "bagging_fraction",
+    "sub_row": "bagging_fraction",
+    "subsample": "bagging_fraction",
+    "bagging": "bagging_fraction",
+    "bagging_freq": "bagging_freq",
+    "subsample_freq": "bagging_freq",
+    "bagging_seed": "bagging_seed",
+    "bagging_fraction_seed": "bagging_seed",
+    "feature_fraction": "feature_fraction",
+    "sub_feature": "feature_fraction",
+    "colsample_bytree": "feature_fraction",
+    "feature_fraction_bynode": "feature_fraction_bynode",
+    "sub_feature_bynode": "feature_fraction_bynode",
+    "colsample_bynode": "feature_fraction_bynode",
+    "feature_fraction_seed": "feature_fraction_seed",
+    "extra_trees": "extra_trees",
+    "early_stopping_round": "early_stopping_round",
+    "early_stopping_rounds": "early_stopping_round",
+    "early_stopping": "early_stopping_round",
+    "n_iter_no_change": "early_stopping_round",
+    "first_metric_only": "first_metric_only",
+    "max_delta_step": "max_delta_step",
+    "lambda_l1": "lambda_l1",
+    "reg_alpha": "lambda_l1",
+    "l1_regularization": "lambda_l1",
+    "lambda_l2": "lambda_l2",
+    "reg_lambda": "lambda_l2",
+    "lambda": "lambda_l2",
+    "l2_regularization": "lambda_l2",
+    "min_gain_to_split": "min_gain_to_split",
+    "min_split_gain": "min_gain_to_split",
+    "top_rate": "top_rate",
+    "goss_top_rate": "top_rate",
+    "other_rate": "other_rate",
+    "goss_other_rate": "other_rate",
+    "verbosity": "verbosity",
+    "verbose": "verbosity",
+    "max_bin": "max_bin",
+    "max_bins": "max_bin",
+    "min_data_in_bin": "min_data_in_bin",
+    "data_random_seed": "data_random_seed",
+    "data_seed": "data_random_seed",
+    "enable_bundle": "enable_bundle",
+    "bundle": "enable_bundle",
+    "efb": "enable_bundle",
+    "is_enable_bundle": "enable_bundle",
+    "max_conflict_rate": "max_conflict_rate",
+    "use_missing": "use_missing",
+    "zero_as_missing": "zero_as_missing",
+    "boost_from_average": "boost_from_average",
+    # objective-specific
+    "num_class": "num_class",
+    "num_classes": "num_class",
+    "is_unbalance": "is_unbalance",
+    "unbalance": "is_unbalance",
+    "unbalanced_sets": "is_unbalance",
+    "scale_pos_weight": "scale_pos_weight",
+    "sigmoid": "sigmoid",
+    "alpha": "alpha",
+    "huber_delta": "alpha",
+    "quantile_alpha": "alpha",
+    "fair_c": "fair_c",
+    "poisson_max_delta_step": "poisson_max_delta_step",
+    "lambdarank_truncation_level": "lambdarank_truncation_level",
+    "lambdarank_norm": "lambdarank_norm",
+    "label_gain": "label_gain",
+    # metric
+    "metric": "metric",
+    "metrics": "metric",
+    "metric_types": "metric",
+    "eval": "metric",  # the R binding's `eval=` arg (LightGBM R.ipynb:437)
+    "eval_metric": "metric",
+    "metric_freq": "metric_freq",
+    "output_freq": "metric_freq",
+    "is_provide_training_metric": "is_provide_training_metric",
+    "training_metric": "is_provide_training_metric",
+    "train_metric": "is_provide_training_metric",
+    "eval_at": "eval_at",
+    "ndcg_at": "eval_at",
+    "ndcg_eval_at": "eval_at",
+    "map_at": "eval_at",
+    "map_eval_at": "eval_at",
+}
+
+_OBJECTIVE_ALIASES: Dict[str, str] = {
+    "regression": "regression",
+    "regression_l2": "regression",
+    "l2": "regression",
+    "mean_squared_error": "regression",
+    "mse": "regression",
+    "l2_root": "regression",
+    "root_mean_squared_error": "regression",
+    "rmse": "regression",
+    "reg:linear": "regression",  # xgboost vocabulary (bagging_boosting.ipynb:121)
+    "reg:squarederror": "regression",
+    "regression_l1": "regression_l1",
+    "l1": "regression_l1",
+    "mean_absolute_error": "regression_l1",
+    "mae": "regression_l1",
+    "huber": "huber",
+    "fair": "fair",
+    "poisson": "poisson",
+    "quantile": "quantile",
+    "binary": "binary",
+    "binary_logloss": "binary",
+    "binary:logistic": "binary",
+    "multiclass": "multiclass",
+    "softmax": "multiclass",
+    "multi:softmax": "multiclass",
+    "multiclassova": "multiclassova",
+    "multiclass_ova": "multiclassova",
+    "ova": "multiclassova",
+    "ovr": "multiclassova",
+    "lambdarank": "lambdarank",
+    "rank_xendcg": "lambdarank",
+    "xendcg": "lambdarank",
+    "rank:pairwise": "lambdarank",
+    "none": "none",
+    "null": "none",
+    "custom": "none",
+    "na": "none",
+}
+
+_METRIC_ALIASES: Dict[str, str] = {
+    "l2": "l2",
+    "mse": "l2",
+    "mean_squared_error": "l2",
+    "regression": "l2",
+    "regression_l2": "l2",
+    "rmse": "rmse",
+    "l2_root": "rmse",
+    "root_mean_squared_error": "rmse",
+    "l1": "l1",
+    "mae": "l1",
+    "mean_absolute_error": "l1",
+    "regression_l1": "l1",
+    "huber": "huber",
+    "fair": "fair",
+    "poisson": "poisson",
+    "quantile": "quantile",
+    "binary_logloss": "binary_logloss",
+    "binary": "binary_logloss",
+    "logloss": "binary_logloss",
+    "log_loss": "binary_logloss",
+    "binary_error": "binary_error",
+    "auc": "auc",
+    "multi_logloss": "multi_logloss",
+    "multiclass": "multi_logloss",
+    "softmax": "multi_logloss",
+    "multiclassova": "multi_logloss",
+    "multi_error": "multi_error",
+    "ndcg": "ndcg",
+    "lambdarank": "ndcg",
+    "rank_xendcg": "ndcg",
+    "map": "map",
+    "mean_average_precision": "map",
+    "none": "none",
+    "na": "none",
+    "null": "none",
+    "custom": "none",
+}
+
+_BOOSTING_ALIASES: Dict[str, str] = {
+    "gbdt": "gbdt",
+    "gbrt": "gbdt",
+    "goss": "goss",
+    "rf": "rf",
+    "random_forest": "rf",
+    "dart": "dart",
+}
+
+
+@dataclasses.dataclass
+class Params:
+    """Canonical resolved parameters (LightGBM defaults)."""
+
+    # core
+    objective: str = "regression"
+    boosting: str = "gbdt"
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_leaves: int = 31
+    max_depth: int = -1
+    tree_learner: str = "serial"  # serial | data | feature | voting
+    num_threads: int = 0  # accepted & ignored: XLA owns parallelism (SURVEY §2C)
+    device_type: str = "tpu"
+    seed: int = 0
+    deterministic: bool = False
+    # learning control
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    bagging_seed: int = 3
+    feature_fraction: float = 1.0
+    feature_fraction_bynode: float = 1.0
+    feature_fraction_seed: int = 2
+    extra_trees: bool = False
+    early_stopping_round: int = 0
+    first_metric_only: bool = False
+    max_delta_step: float = 0.0
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+    verbosity: int = 1
+    # dataset
+    max_bin: int = 255
+    min_data_in_bin: int = 3
+    data_random_seed: int = 1
+    enable_bundle: bool = True
+    max_conflict_rate: float = 0.0
+    use_missing: bool = True
+    zero_as_missing: bool = False
+    # objective-specific
+    boost_from_average: bool = True
+    num_class: int = 1
+    is_unbalance: bool = False
+    scale_pos_weight: float = 1.0
+    sigmoid: float = 1.0
+    alpha: float = 0.9
+    fair_c: float = 1.0
+    poisson_max_delta_step: float = 0.7
+    lambdarank_truncation_level: int = 30
+    lambdarank_norm: bool = True
+    label_gain: Optional[List[float]] = None
+    # metric
+    metric: List[str] = dataclasses.field(default_factory=list)
+    metric_freq: int = 1
+    is_provide_training_metric: bool = False
+    eval_at: List[int] = dataclasses.field(default_factory=lambda: [1, 2, 3, 4, 5])
+    # passthrough of anything unrecognized (kept for introspection)
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def copy(self) -> "Params":
+        return dataclasses.replace(
+            self,
+            metric=list(self.metric),
+            eval_at=list(self.eval_at),
+            extra=dict(self.extra),
+        )
+
+
+_BOOL_FIELDS = {
+    f.name for f in dataclasses.fields(Params) if f.type in ("bool", bool)
+}
+_INT_FIELDS = {f.name for f in dataclasses.fields(Params) if f.type in ("int", int)}
+_FLOAT_FIELDS = {
+    f.name for f in dataclasses.fields(Params) if f.type in ("float", float)
+}
+
+
+def _coerce(name: str, value: Any) -> Any:
+    if name in _BOOL_FIELDS:
+        if isinstance(value, str):
+            return value.lower() in ("true", "1", "yes", "+")
+        return bool(value)
+    if name in _INT_FIELDS:
+        return int(value)
+    if name in _FLOAT_FIELDS:
+        return float(value)
+    return value
+
+
+def _normalize_metric(value: Union[str, Sequence[str], None]) -> List[str]:
+    if value is None:
+        return []
+    if isinstance(value, str):
+        value = [v.strip() for v in value.split(",") if v.strip()]
+    out: List[str] = []
+    for m in value:
+        key = str(m).lower()
+        canon = _METRIC_ALIASES.get(key)
+        if canon is None:
+            warnings.warn(f"Unknown metric '{m}' ignored")
+            continue
+        if canon not in out:
+            out.append(canon)
+    return out
+
+
+def parse_params(
+    params: Optional[Dict[str, Any]] = None,
+    *,
+    base: Optional[Params] = None,
+    warn_unknown: bool = True,
+    **overrides: Any,
+) -> Params:
+    """Resolve a user param dict (LightGBM vocabulary) into a :class:`Params`.
+
+    Later duplicates of the same canonical parameter win, matching LightGBM's
+    "last alias wins" behavior.  Unknown keys are preserved in ``extra`` with a
+    warning (the reference grid rows carry ``nthread`` through params —
+    r/gridsearchCV.R:100 — which maps to the ignored ``num_threads``).
+    """
+    out = base.copy() if base is not None else Params()
+    merged: Dict[str, Any] = {}
+    for src in (params or {}), overrides:
+        for k, v in src.items():
+            if v is None:
+                continue
+            merged[k] = v
+    for key, value in merged.items():
+        canon = _ALIASES.get(str(key).lower())
+        if canon is None:
+            if warn_unknown:
+                warnings.warn(f"Unknown parameter '{key}' ignored", stacklevel=2)
+            out.extra[str(key)] = value
+            continue
+        if canon == "metric":
+            out.metric = _normalize_metric(value)
+        elif canon == "objective":
+            if callable(value):
+                out.extra["fobj"] = value
+                out.objective = "none"
+                continue
+            ov = _OBJECTIVE_ALIASES.get(str(value).lower())
+            if ov is None:
+                raise ValueError(f"Unknown objective: {value!r}")
+            out.objective = ov
+        elif canon == "boosting":
+            bv = _BOOSTING_ALIASES.get(str(value).lower())
+            if bv is None:
+                raise ValueError(f"Unknown boosting type: {value!r}")
+            out.boosting = bv
+        elif canon in ("label_gain", "eval_at"):
+            if isinstance(value, str):
+                value = [float(v) for v in value.split(",")]
+            setattr(out, canon, [int(v) if canon == "eval_at" else float(v) for v in value])
+        else:
+            setattr(out, canon, _coerce(canon, value))
+    _validate(out)
+    return out
+
+
+def _validate(p: Params) -> None:
+    if p.num_leaves < 2:
+        raise ValueError(f"num_leaves must be >= 2, got {p.num_leaves}")
+    if p.num_leaves > 131072:
+        raise ValueError(f"num_leaves too large: {p.num_leaves}")
+    if not (1 < p.max_bin <= 256):
+        raise ValueError(f"max_bin must be in (1, 256], got {p.max_bin}")
+    if not (0.0 < p.bagging_fraction <= 1.0):
+        raise ValueError(f"bagging_fraction must be in (0, 1], got {p.bagging_fraction}")
+    if not (0.0 < p.feature_fraction <= 1.0):
+        raise ValueError(f"feature_fraction must be in (0, 1], got {p.feature_fraction}")
+    if p.learning_rate <= 0:
+        raise ValueError(f"learning_rate must be > 0, got {p.learning_rate}")
+    if p.objective in ("multiclass", "multiclassova") and p.num_class < 2:
+        raise ValueError("multiclass objective requires num_class >= 2")
+    if p.boosting == "rf":
+        if p.bagging_freq <= 0 or not (0.0 < p.bagging_fraction < 1.0):
+            # LightGBM requires bagging for rf mode; default to sklearn-ish bootstrap
+            p.bagging_freq = max(p.bagging_freq, 1)
+            if p.bagging_fraction >= 1.0:
+                p.bagging_fraction = 0.632  # P(row in bootstrap sample)
+
+
+def default_metric_for_objective(objective: str) -> str:
+    """LightGBM's default metric when `metric`/`eval` is omitted.
+
+    The reference sweep relies on this: with no ``eval`` arg the regression
+    metric defaults to **l2 (MSE)** — proven by paramGrid.RData score
+    magnitudes (SURVEY.md §2A row 5, r/gridsearchCV.R:108-115).
+    """
+    return {
+        "regression": "l2",
+        "regression_l1": "l1",
+        "huber": "huber",
+        "fair": "fair",
+        "poisson": "poisson",
+        "quantile": "quantile",
+        "binary": "binary_logloss",
+        "multiclass": "multi_logloss",
+        "multiclassova": "multi_logloss",
+        "lambdarank": "ndcg",
+        "none": "none",
+    }.get(objective, "l2")

@@ -1,0 +1,416 @@
+"""Binned dataset container — the `lgb.Dataset` equivalent.
+
+Reference contract (SURVEY.md §2B): ``lgb.Dataset(X, label=)`` wraps a dense
+numeric matrix + label, lazily binned with ≤``max_bin`` (default 255) bins per
+feature, and is reusable across many trainings (the reference reuses one
+``dtrain`` across a 108-config sweep — r/gridsearchCV.R:52,108).
+
+TPU-first design (SURVEY.md §7): the binned matrix is a device-resident
+``uint8[rows_padded, features]`` with rows padded to a lane-friendly multiple
+so it can later be row-sharded over a ``jax.sharding.Mesh`` without reshapes.
+Labels/weights ride alongside as f32.  Binning itself (a one-time, per-feature
+quantile sketch) runs on host in numpy — it is O(n log n) scalar work that XLA
+has no advantage on — and produces the bin-upper-bound table used both for
+training data and for mapping validation/prediction inputs into the same bins.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Params, parse_params
+
+ROW_PAD_MULTIPLE = 256  # lane-friendly and shard-friendly (divides by 2,4,8 devices)
+
+
+class BinMapper:
+    """Per-feature quantile binning table (LightGBM BinMapper equivalent).
+
+    For each feature stores ascending ``upper_bounds`` such that raw value v
+    maps to bin ``searchsorted(upper_bounds, v, side='left')``; the last bound
+    is +inf.  NaN maps to the dedicated last bin (index ``n_bins-1``) when the
+    feature has missing values, else NaN never occurs.
+    """
+
+    def __init__(self, upper_bounds: List[np.ndarray], nan_bin: np.ndarray,
+                 n_bins: np.ndarray, is_categorical: Optional[np.ndarray] = None):
+        self.upper_bounds = upper_bounds          # list of f64[n_bins_f - 1] finite bounds
+        self.nan_bin = nan_bin                    # i32[F]: bin index for NaN (or -1)
+        self.n_bins = n_bins                      # i32[F]: bins actually used per feature
+        self.num_features = len(upper_bounds)
+        self.is_categorical = (
+            is_categorical if is_categorical is not None
+            else np.zeros(self.num_features, dtype=bool)
+        )
+
+    @property
+    def max_num_bins(self) -> int:
+        return int(self.n_bins.max()) if len(self.n_bins) else 1
+
+    @staticmethod
+    def fit(
+        X: np.ndarray,
+        max_bin: int = 255,
+        min_data_in_bin: int = 3,
+        categorical: Sequence[int] = (),
+        sample_cnt: int = 200_000,
+        seed: int = 1,
+    ) -> "BinMapper":
+        """Build bin bounds per feature via (sampled) quantiles.
+
+        Mirrors LightGBM's GreedyFindBin behavior loosely: distinct values get
+        their own bins when few; otherwise equal-frequency quantile bins;
+        a dedicated NaN bin is appended when the feature has missing values.
+        """
+        n, num_features = X.shape
+        rng = np.random.default_rng(seed)
+        if n > sample_cnt:
+            idx = rng.choice(n, size=sample_cnt, replace=False)
+        else:
+            idx = slice(None)
+        cat = set(int(c) for c in categorical)
+        bounds: List[np.ndarray] = []
+        nan_bin = np.full(num_features, -1, dtype=np.int32)
+        n_bins = np.ones(num_features, dtype=np.int32)
+        is_cat = np.zeros(num_features, dtype=bool)
+        for f in range(num_features):
+            col = np.asarray(X[idx, f], dtype=np.float64)
+            has_nan = bool(np.isnan(col).any())
+            vals = col[~np.isnan(col)]
+            budget = max_bin - (1 if has_nan else 0)
+            if f in cat:
+                # categorical: one bin per kept category value (exact match at
+                # transform time; unseen/rare values share the overflow bin).
+                # NOTE: splits over these bins are still ordered thresholds;
+                # LightGBM-style subset splits are milestone M4.
+                is_cat[f] = True
+                cats = np.unique(vals)
+                if len(cats) > budget - 1:
+                    uniq, cnts = np.unique(vals, return_counts=True)
+                    cats = np.sort(uniq[np.argsort(-cnts)[: budget - 1]])
+                ub = cats  # stores category VALUES for categorical features
+            elif len(vals) == 0:
+                ub = np.zeros(0)
+            else:
+                # honor min_data_in_bin (LightGBM GreedyFindBin): cap the bin
+                # count so the average bin holds >= min_data_in_bin samples...
+                budget_eff = budget
+                if min_data_in_bin > 1:
+                    budget_eff = max(1, min(budget,
+                                            len(vals) // min_data_in_bin))
+                distinct, counts = np.unique(vals, return_counts=True)
+                if len(distinct) <= budget_eff:
+                    mids = (distinct[:-1] + distinct[1:]) / 2.0
+                    if min_data_in_bin > 1 and len(distinct) > 1:
+                        # ...and greedily merge adjacent sparse distinct
+                        # values until each bin reaches the floor.
+                        keep, acc = [], 0
+                        for i in range(len(distinct) - 1):
+                            acc += counts[i]
+                            if acc >= min_data_in_bin and \
+                                    counts[i + 1:].sum() >= min_data_in_bin:
+                                keep.append(mids[i])
+                                acc = 0
+                        ub = np.asarray(keep)
+                    else:
+                        ub = mids
+                else:
+                    qs = np.linspace(0.0, 1.0, budget_eff + 1)[1:-1]
+                    ub = np.unique(np.quantile(vals, qs, method="linear"))
+                    # drop near-duplicate bounds
+                    if len(ub) > 1:
+                        ub = ub[np.concatenate(([True], np.diff(ub) > 0))]
+            ub = np.asarray(ub, dtype=np.float64)
+            nb = len(ub) + 1
+            if has_nan:
+                nan_bin[f] = nb
+                nb += 1
+            bounds.append(ub)
+            n_bins[f] = nb
+        return BinMapper(bounds, nan_bin, n_bins, is_cat)
+
+    def transform(self, X: np.ndarray) -> np.ndarray:
+        """Map raw features to bin codes uint8[n, F]."""
+        n, num_features = X.shape
+        assert num_features == self.num_features, (
+            f"feature count mismatch: {num_features} vs {self.num_features}")
+        out = np.empty((n, num_features), dtype=np.uint8)
+        for f in range(num_features):
+            col = np.asarray(X[:, f], dtype=np.float64)
+            if self.is_categorical[f]:
+                cats = self.upper_bounds[f]
+                idx = np.searchsorted(cats, col).clip(0, max(len(cats) - 1, 0))
+                if len(cats) > 0:
+                    hit = cats[idx] == col
+                    codes = np.where(hit, idx, len(cats))  # overflow bin
+                else:
+                    codes = np.zeros(n, dtype=np.int64)
+            else:
+                codes = np.searchsorted(self.upper_bounds[f], col, side="left")
+            if self.nan_bin[f] >= 0:
+                codes = np.where(np.isnan(col), self.nan_bin[f], codes)
+            else:
+                # features with no NaN at fit time: clamp NaN to last real bin
+                codes = np.where(np.isnan(col), self.n_bins[f] - 1, codes)
+            out[:, f] = codes.astype(np.uint8)
+        return out
+
+    def bin_upper_bound(self, feature: int, bin_idx: int) -> float:
+        """Raw-value threshold corresponding to `bin <= bin_idx` (for model dump)."""
+        ub = self.upper_bounds[feature]
+        if bin_idx < len(ub):
+            return float(ub[bin_idx])
+        return float("inf")
+
+
+def _to_2d_float_array(data: Any) -> np.ndarray:
+    """Accept numpy / pandas / list-of-lists; return f64 ndarray [n, F]."""
+    if hasattr(data, "to_numpy"):  # pandas DataFrame/Series
+        data = data.to_numpy()
+    arr = np.asarray(data)
+    if arr.dtype == object:
+        arr = arr.astype(np.float64)
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    if arr.ndim != 2:
+        raise ValueError(f"data must be 2-D, got shape {arr.shape}")
+    return np.ascontiguousarray(arr, dtype=np.float64)
+
+
+def _to_1d_float_array(x: Any) -> np.ndarray:
+    if hasattr(x, "to_numpy"):
+        x = x.to_numpy()
+    arr = np.asarray(x, dtype=np.float64).reshape(-1)
+    return arr
+
+
+class Dataset:
+    """`lgb.Dataset` equivalent: lazily-binned training data container.
+
+    >>> dtrain = Dataset(X, label=y)
+    >>> booster = lgb.train(params, dtrain, num_boost_round=200)
+
+    Validation sets must share the training set's bin mapper; pass
+    ``reference=dtrain`` exactly as in LightGBM.
+    """
+
+    def __init__(
+        self,
+        data: Any,
+        label: Any = None,
+        *,
+        weight: Any = None,
+        group: Any = None,
+        init_score: Any = None,
+        reference: Optional["Dataset"] = None,
+        feature_name: Union[str, Sequence[str]] = "auto",
+        categorical_feature: Union[str, Sequence[Union[int, str]]] = "auto",
+        params: Optional[Dict[str, Any]] = None,
+        free_raw_data: bool = False,
+    ):
+        self.raw_data = data
+        self._label = None if label is None else _to_1d_float_array(label)
+        self._weight = None if weight is None else _to_1d_float_array(weight)
+        self._group = None if group is None else np.asarray(group, dtype=np.int64).reshape(-1)
+        self._init_score = None if init_score is None else _to_1d_float_array(init_score)
+        self.reference = reference
+        self.params: Dict[str, Any] = dict(params or {})
+        self.free_raw_data = free_raw_data
+        self._feature_name_arg = feature_name
+        self._categorical_feature_arg = categorical_feature
+
+        self.bin_mapper: Optional[BinMapper] = reference.bin_mapper if reference is not None else None
+        self._constructed = False
+        self.num_data_: Optional[int] = None
+        self.num_feature_: Optional[int] = None
+        self.feature_names: Optional[List[str]] = None
+        # device-side products (filled by construct())
+        self.X_binned = None      # jnp.uint8 [n_pad, F]
+        self.y = None             # jnp.float32 [n_pad]
+        self.w = None             # jnp.float32 [n_pad] (0 on padding)
+        self.row_mask = None      # jnp.float32 [n_pad] 1/0 validity
+        self.group_id = None      # jnp.int32 [n_pad] query ids for ranking (-1 pad)
+
+    # -- lightgbm-compatible introspection ---------------------------------
+    def num_data(self) -> int:
+        self.construct()
+        return int(self.num_data_)
+
+    def num_feature(self) -> int:
+        self.construct()
+        return int(self.num_feature_)
+
+    def get_label(self) -> Optional[np.ndarray]:
+        return self._label
+
+    def set_label(self, label) -> "Dataset":
+        self._label = None if label is None else _to_1d_float_array(label)
+        if self._constructed and self._label is not None:
+            self._device_put_targets()
+        return self
+
+    def get_weight(self) -> Optional[np.ndarray]:
+        return self._weight
+
+    def set_weight(self, weight) -> "Dataset":
+        self._weight = None if weight is None else _to_1d_float_array(weight)
+        if self._constructed:
+            self._device_put_targets()
+        return self
+
+    def get_group(self) -> Optional[np.ndarray]:
+        return self._group
+
+    def set_group(self, group) -> "Dataset":
+        self._group = None if group is None else np.asarray(group, dtype=np.int64).reshape(-1)
+        if self._constructed:
+            self._device_put_targets()
+        return self
+
+    def get_init_score(self) -> Optional[np.ndarray]:
+        return self._init_score
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self._init_score = None if init_score is None else _to_1d_float_array(init_score)
+        return self
+
+    def get_field(self, name: str):
+        return {
+            "label": self._label, "weight": self._weight,
+            "group": self._group, "init_score": self._init_score,
+        }[name]
+
+    def set_field(self, name: str, value) -> "Dataset":
+        return getattr(self, f"set_{name}")(value)
+
+    # -- construction -------------------------------------------------------
+    def _resolve_feature_names(self, num_features: int) -> List[str]:
+        fn = self._feature_name_arg
+        if fn == "auto" or fn is None:
+            if hasattr(self.raw_data, "columns"):
+                return [str(c) for c in self.raw_data.columns]
+            return [f"Column_{i}" for i in range(num_features)]
+        names = list(fn)
+        if len(names) != num_features:
+            raise ValueError("feature_name length mismatch")
+        return [str(c) for c in names]
+
+    def _resolve_categorical(self, feature_names: List[str]) -> List[int]:
+        cf = self._categorical_feature_arg
+        if cf == "auto" or cf is None:
+            return []
+        out = []
+        for c in cf:
+            if isinstance(c, str):
+                if c not in feature_names:
+                    raise ValueError(f"categorical_feature '{c}' not in feature names")
+                out.append(feature_names.index(c))
+            else:
+                out.append(int(c))
+        return sorted(set(out))
+
+    def construct(self) -> "Dataset":
+        if self._constructed:
+            return self
+        import jax.numpy as jnp  # deferred so Dataset import stays cheap
+
+        p = parse_params(self.params, warn_unknown=False)
+        X = _to_2d_float_array(self.raw_data)
+        n, num_features = X.shape
+        self.num_data_ = n
+        self.num_feature_ = num_features
+        self.feature_names = self._resolve_feature_names(num_features)
+        cat_idx = self._resolve_categorical(self.feature_names)
+
+        if self.bin_mapper is None:
+            self.bin_mapper = BinMapper.fit(
+                X, max_bin=p.max_bin, min_data_in_bin=p.min_data_in_bin,
+                categorical=cat_idx, seed=p.data_random_seed)
+        codes = self.bin_mapper.transform(X)
+
+        n_pad = -(-n // ROW_PAD_MULTIPLE) * ROW_PAD_MULTIPLE
+        pad = n_pad - n
+        if pad:
+            codes = np.concatenate([codes, np.zeros((pad, num_features), np.uint8)], axis=0)
+        self.X_binned = jnp.asarray(codes)
+        mask = np.zeros(n_pad, dtype=np.float32)
+        mask[:n] = 1.0
+        self.row_mask = jnp.asarray(mask)
+        self._device_put_targets()
+        self._constructed = True
+        if self.free_raw_data:
+            self.raw_data = None
+        return self
+
+    def _device_put_targets(self) -> None:
+        import jax.numpy as jnp
+
+        n, n_pad = self.num_data_, int(self.row_mask.shape[0]) if self.row_mask is not None else None
+        if n_pad is None:
+            return
+        pad = n_pad - n
+        if self._label is not None:
+            y = np.asarray(self._label, dtype=np.float32)
+            if len(y) != n:
+                raise ValueError(f"label length {len(y)} != num_data {n}")
+            self.y = jnp.asarray(np.concatenate([y, np.zeros(pad, np.float32)]))
+        w = np.ones(n, dtype=np.float32) if self._weight is None else np.asarray(self._weight, np.float32)
+        if len(w) != n:
+            raise ValueError(f"weight length {len(w)} != num_data {n}")
+        self.w = jnp.asarray(np.concatenate([w, np.zeros(pad, np.float32)]))
+        if self._group is not None:
+            if self._group.sum() != n:
+                raise ValueError("group sizes must sum to num_data")
+            gid = np.repeat(np.arange(len(self._group)), self._group).astype(np.int32)
+            self.group_id = jnp.asarray(np.concatenate([gid, np.full(pad, -1, np.int32)]))
+        else:
+            self.group_id = None  # clear any stale copy (e.g. via subset())
+
+    # -- lightgbm API surface ------------------------------------------------
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, weight=weight, group=group,
+                       init_score=init_score, reference=self, params=params or self.params)
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        """Row-subset sharing this dataset's bin mapper (used by cv folds)."""
+        self.construct()
+        used = np.asarray(used_indices, dtype=np.int64)
+        codes = np.asarray(self.X_binned)[: self.num_data_][used]
+        sub = Dataset.__new__(Dataset)
+        sub.__dict__.update(self.__dict__)
+        sub.raw_data = None
+        sub._constructed = False
+        sub.params = dict(params or self.params)
+        sub._label = None if self._label is None else self._label[used]
+        sub._weight = None if self._weight is None else self._weight[used]
+        sub._group = None
+        sub._init_score = None if self._init_score is None else self._init_score[used]
+        sub._from_codes(codes)
+        return sub
+
+    def _from_codes(self, codes: np.ndarray) -> None:
+        import jax.numpy as jnp
+
+        n, num_features = codes.shape
+        self.num_data_ = n
+        self.num_feature_ = num_features
+        n_pad = -(-n // ROW_PAD_MULTIPLE) * ROW_PAD_MULTIPLE
+        pad = n_pad - n
+        if pad:
+            codes = np.concatenate([codes, np.zeros((pad, num_features), np.uint8)], axis=0)
+        self.X_binned = jnp.asarray(codes)
+        mask = np.zeros(n_pad, dtype=np.float32)
+        mask[:n] = 1.0
+        self.row_mask = jnp.asarray(mask)
+        self._device_put_targets()
+        self._constructed = True
+
+    @property
+    def num_bins(self) -> int:
+        """Padded bin-axis size (power-of-two-ish for kernel friendliness)."""
+        self.construct()
+        return max(2, self.bin_mapper.max_num_bins)

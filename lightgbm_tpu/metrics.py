@@ -1,0 +1,130 @@
+"""Evaluation metrics (LightGBM ``src/metric/`` equivalents).
+
+Exercised by the reference via ``eval="rmse"`` (LightGBM R.ipynb:437) and the
+default-l2 sweep (r/gridsearchCV.R:108-115; SURVEY.md §2B row `lgb.cv`).
+
+All metrics are weighted means computed on device so that per-round early-
+stopping evaluation adds no host round-trips beyond the scalar fetch.  The
+**sign-flip convention** of the R binding ("LightGBM flips sign so that high
+values are good", LightGBM R.ipynb:443) is applied in the cv compat layer, not
+here: metric values here follow the Python lightgbm convention (raw value +
+``higher_better`` flag).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, NamedTuple, Optional
+
+import jax.numpy as jnp
+
+
+class Metric(NamedTuple):
+    name: str
+    higher_better: bool
+    # fn(transformed_pred, y, w) -> scalar; w is 0 on padding rows.
+    fn: Callable
+
+
+def _wmean(values, w):
+    return jnp.sum(values * w) / jnp.maximum(jnp.sum(w), 1e-12)
+
+
+def _l2(pred, y, w):
+    return _wmean((pred - y) ** 2, w)
+
+
+def _rmse(pred, y, w):
+    return jnp.sqrt(_l2(pred, y, w))
+
+
+def _l1(pred, y, w):
+    return _wmean(jnp.abs(pred - y), w)
+
+
+def _huber(pred, y, w, alpha=0.9):
+    r = jnp.abs(pred - y)
+    loss = jnp.where(r <= alpha, 0.5 * r * r, alpha * (r - 0.5 * alpha))
+    return _wmean(loss, w)
+
+
+def _binary_logloss(p, y, w):
+    p = jnp.clip(p, 1e-15, 1 - 1e-15)
+    return _wmean(-(y * jnp.log(p) + (1 - y) * jnp.log(1 - p)), w)
+
+
+def _binary_error(p, y, w):
+    return _wmean(((p > 0.5) != (y > 0.5)).astype(jnp.float32), w)
+
+
+def _poisson_nll(mu, y, w):
+    mu = jnp.maximum(mu, 1e-15)
+    return _wmean(mu - y * jnp.log(mu), w)
+
+
+def _quantile(pred, y, w, alpha=0.9):
+    r = y - pred
+    return _wmean(jnp.maximum(alpha * r, (alpha - 1) * r), w)
+
+
+def _auc(score, y, w):
+    """Weighted ROC-AUC via the rank statistic, fully on device.
+
+    Sort-free tie handling: ranks computed with double argsort on the scores;
+    ties get averaged ranks through midpoint correction using a stable sort of
+    (score, index).  Matches sklearn.roc_auc_score to float32 precision.
+    """
+    n = score.shape[0]
+    order = jnp.argsort(score)  # ascending
+    s_sorted = score[order]
+    y_sorted = y[order]
+    w_sorted = w[order]
+    pos_w = w_sorted * (y_sorted > 0.5)
+    neg_w = w_sorted * (y_sorted <= 0.5)
+    # cumulative negative weight strictly below each element + half of ties
+    cum_neg = jnp.cumsum(neg_w)
+    # group ties: elements with equal score must share the same "negatives
+    # below" value = (cum_neg at group end + cum_neg at group start-1) / 2
+    same_as_prev = jnp.concatenate(
+        [jnp.zeros(1, bool), s_sorted[1:] == s_sorted[:-1]])
+    # segment ids for tie groups
+    gid = jnp.cumsum(~same_as_prev) - 1
+    # per-group start/end cum_neg via segment min/max
+    num_seg = n
+    seg_start = jnp.full(num_seg, jnp.inf).at[gid].min(
+        jnp.concatenate([jnp.zeros(1), cum_neg[:-1]]))
+    seg_end = jnp.full(num_seg, -jnp.inf).at[gid].max(cum_neg)
+    neg_below = 0.5 * (seg_start[gid] + seg_end[gid])
+    total_pos = jnp.sum(pos_w)
+    total_neg = jnp.sum(neg_w)
+    auc = jnp.sum(pos_w * neg_below) / jnp.maximum(total_pos * total_neg, 1e-12)
+    return auc
+
+
+_METRICS: Dict[str, Metric] = {
+    "l2": Metric("l2", False, _l2),
+    "rmse": Metric("rmse", False, _rmse),
+    "l1": Metric("l1", False, _l1),
+    "huber": Metric("huber", False, _huber),
+    "poisson": Metric("poisson", False, _poisson_nll),
+    "quantile": Metric("quantile", False, _quantile),
+    "binary_logloss": Metric("binary_logloss", False, _binary_logloss),
+    "binary_error": Metric("binary_error", False, _binary_error),
+    "auc": Metric("auc", True, _auc),
+}
+
+
+def get_metric(name: str, params=None) -> Metric:
+    if name in ("multi_logloss", "multi_error"):
+        from .multiclass import get_multiclass_metric
+        return get_multiclass_metric(name, params)
+    if name in ("ndcg", "map"):
+        from .ranking import get_ranking_metric
+        return get_ranking_metric(name, params)
+    m = _METRICS.get(name)
+    if m is None:
+        raise ValueError(f"Unknown metric: {name}")
+    if params is not None and name in ("huber", "quantile"):
+        alpha = float(params.alpha)
+        return Metric(m.name, m.higher_better,
+                      lambda p, y, w, a=alpha: m.fn(p, y, w, a))
+    return m

@@ -1,0 +1,6 @@
+"""Model families: GBDT booster, tensorized trees, bagged forests."""
+
+from .tree import Tree, empty_forest, grow_tree
+from .gbdt import Booster, HyperScalars
+
+__all__ = ["Tree", "empty_forest", "grow_tree", "Booster", "HyperScalars"]

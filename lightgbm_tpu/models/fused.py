@@ -1,0 +1,274 @@
+"""Fused cross-validation trainer: the TPU answer to the reference sweep.
+
+The reference's workload (SURVEY.md §3.2-3.3) is `lgb.cv` inside a serial
+108-config grid — 5 folds × ≤1000 rounds × 108 configs, early-stopped on the
+fold-mean metric, ~30 CPU-minutes.  A host-loop port pays a device round-trip
+per boosting round per fold (early stopping is data-dependent), which is
+latency-bound on TPU.
+
+This module folds an ENTIRE batch of cv trainings into one XLA program:
+
+  * rounds       -> `lax.while_loop` with ON-DEVICE early stopping (the
+                    patience counters live in the carry: zero host syncs
+                    until every config has stopped);
+  * folds        -> a vmapped batch axis over fold train-masks;
+  * grid configs -> the same batch axis: every regularization knob is a
+                    traced scalar (HyperScalars/SplitContext), so one
+                    compiled program serves all configs sharing
+                    (num_leaves, num_bins), batched as [configs × folds];
+  * histograms   -> the batched one-hot einsum gains a configs*folds*stats
+                    inner dimension — the shape that finally feeds the MXU
+                    properly.
+
+Key trick: all rows (train + held-out) live in ONE binned matrix; held-out
+rows simply carry zero gradient/hessian/count weight.  `grow_tree` partitions
+every row through the split decisions regardless of weight, so fold-valid
+predictions fall out of the same `leaf_value[row_leaf]` gather that updates
+training scores — no separate traversal pass.
+
+CV does not keep trees (the reference reads only best_iter / best_score —
+r/gridsearchCV.R:116-117), so per-element memory is O(rows) predictions plus
+O(T_max) metric history, letting a 36-config × 5-fold batch run as one
+program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import Params, default_metric_for_objective
+from ..metrics import get_metric
+from .gbdt import HyperScalars, _objective_static_key, _rebuild_objective
+from .tree import grow_tree
+
+
+class FusedCVCarry(NamedTuple):
+    r: jnp.ndarray              # i32[] current round
+    pred: jnp.ndarray           # f32[BATCH, n] raw scores (all rows)
+    bag: jnp.ndarray            # f32[BATCH, n] current bagging mask
+    history: jnp.ndarray        # f32[T_max, BATCH] per-round valid metric
+    best_score: jnp.ndarray     # f32[C] sign-normalized best mean metric
+    best_iter: jnp.ndarray      # i32[C] 0-based round of the best score
+    done: jnp.ndarray           # bool[C]
+
+
+class FusedCVResult(NamedTuple):
+    history: jnp.ndarray        # f32[T_max, C, K] per-round per-fold metric
+    best_iter: jnp.ndarray      # i32[C] 1-based best iteration
+    best_score: jnp.ndarray     # f32[C] raw mean metric at the best round
+    rounds_run: jnp.ndarray     # i32[]
+
+
+from ..ops.sampling import sample_bag as _sample_bag
+from ..ops.sampling import sample_feature_mask as _sample_features_within
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_cv_fn(obj_key: tuple, num_leaves: int, num_bins: int,
+                 metric_name: str, metric_alpha: float, t_max: int,
+                 bagging_freq: int, n_configs: int, n_folds: int,
+                 hist_impl: str, row_chunk: int):
+    """Build the jitted fused-cv program for one static configuration."""
+    obj = _rebuild_objective(obj_key)
+    metric = get_metric(metric_name, Params(alpha=metric_alpha))
+    sign = 1.0 if metric.higher_better else -1.0
+    batch = n_configs * n_folds
+
+    def one_element_round(bins, y, w, pred, bag, hyper: HyperScalars, ff,
+                          key):
+        """One boosting round for one (config, fold) batch element."""
+        num_features = bins.shape[1]
+        g, h = obj.grad_hess(pred, y, w)
+        stats = jnp.stack([g * bag, h * bag, bag], axis=-1)
+        fmask = _sample_features_within(jax.random.fold_in(key, 1), ff,
+                                        num_features)
+        tree, row_leaf = grow_tree(
+            bins, stats, fmask, hyper.ctx(), num_leaves, num_bins,
+            hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
+            key=jax.random.fold_in(key, 2), hist_impl=hist_impl,
+            row_chunk=row_chunk)
+        return pred + hyper.learning_rate * tree.leaf_value[row_leaf]
+
+    @jax.jit
+    def run_segment(carry: FusedCVCarry, seg_end, bins, y, w, train_masks,
+                    valid_masks, hyper_b: HyperScalars, bag_frac_b, ff_b,
+                    n_in_fold_b, es_rounds, base_key) -> FusedCVCarry:
+        """Run rounds [carry.r, seg_end) — bounded per-dispatch runtime so a
+        multi-minute cv batch is many short device programs, not one long
+        one (long single executions can trip TPU runtime watchdogs), while
+        early stopping still runs fully on device within each segment."""
+
+        def body(c: FusedCVCarry) -> FusedCVCarry:
+            r = c.r
+            rkey = jax.random.fold_in(base_key, r)
+            bkeys = jax.random.split(jax.random.fold_in(rkey, 0), batch)
+            tkeys = jax.random.split(jax.random.fold_in(rkey, 1), batch)
+
+            if bagging_freq > 0:
+                bag = lax.cond(
+                    r % bagging_freq == 0,
+                    lambda _: jax.vmap(_sample_bag)(
+                        bkeys, train_masks, bag_frac_b, n_in_fold_b),
+                    lambda _: c.bag, None)
+            else:
+                bag = c.bag
+
+            pred = jax.vmap(
+                one_element_round,
+                in_axes=(None, None, None, 0, 0, 0, 0, 0))(
+                    bins, y, w, c.pred, bag, hyper_b, ff_b, tkeys)
+
+            tpred = obj.transform(pred)
+            mvals = jax.vmap(lambda p, vm: metric.fn(p, y, w * vm))(
+                tpred, valid_masks)                      # [BATCH]
+            history = c.history.at[r].set(mvals)
+
+            mean_by_cfg = mvals.reshape(n_configs, n_folds).mean(axis=1)
+            score = sign * mean_by_cfg
+            improved = (score > c.best_score) & ~c.done
+            best_score = jnp.where(improved, score, c.best_score)
+            best_iter = jnp.where(improved, r, c.best_iter)
+            stalled = (r - best_iter >= es_rounds) & (es_rounds > 0)
+            return FusedCVCarry(r + 1, pred, bag, history, best_score,
+                                best_iter, c.done | stalled)
+
+        def cond(c: FusedCVCarry) -> jnp.ndarray:
+            return (c.r < seg_end) & ~jnp.all(c.done)
+
+        return lax.while_loop(cond, body, carry)
+
+    def init_carry(n: int, pred0) -> FusedCVCarry:
+        return FusedCVCarry(
+            r=jnp.int32(0),
+            pred=jnp.broadcast_to(pred0[:, None], (batch, n)),
+            bag=jnp.zeros((batch, n), jnp.float32),  # set by caller
+            history=jnp.full((t_max, batch), jnp.nan, jnp.float32),
+            best_score=jnp.full((n_configs,), -jnp.inf, jnp.float32),
+            best_iter=jnp.zeros((n_configs,), jnp.int32),
+            done=jnp.zeros((n_configs,), bool),
+        )
+
+    def finalize(carry: FusedCVCarry) -> FusedCVResult:
+        return FusedCVResult(
+            history=carry.history.reshape(t_max, n_configs, n_folds),
+            best_iter=carry.best_iter + 1,
+            best_score=sign * carry.best_score,
+            rounds_run=carry.r,
+        )
+
+    return run_segment, init_carry, finalize
+
+
+def fused_cv_eligible(p: Params, feval, callbacks) -> bool:
+    """The fused path covers the reference's cv contract; anything needing
+    per-round host hooks falls back to the host loop."""
+    if feval is not None or callbacks:
+        return False
+    if p.extra.get("fobj") is not None:
+        return False
+    if p.objective in ("multiclass", "multiclassova", "lambdarank", "none"):
+        return False
+    metrics = [m for m in p.metric if m != "none"]
+    if len(metrics) > 1:
+        return False
+    if p.boosting not in ("gbdt",):
+        return False
+    return True
+
+
+def run_fused_cv_batch(
+    train_set,
+    param_list: Sequence[Params],
+    fold_masks: np.ndarray,        # bool [n_folds, n] True = in-train
+    num_boost_round: int,
+    early_stopping_rounds: int,
+    seed: int,
+):
+    """Execute a batch of cv trainings (all sharing num_leaves/max_bin/
+    objective statics) as one fused program.
+
+    Returns (history [T, C, K] numpy with NaN tail, best_iter [C],
+    best_score_raw [C], rounds_run).
+    """
+    p0 = param_list[0]
+    metrics = [m for m in p0.metric if m != "none"] or \
+        [default_metric_for_objective(p0.objective)]
+    metric_name = metrics[0]
+
+    train_set.construct()
+    n_pad = int(train_set.row_mask.shape[0])
+    n = train_set.num_data()
+    n_folds, _ = fold_masks.shape
+    n_configs = len(param_list)
+
+    # [BATCH, n_pad] masks; padding rows excluded everywhere
+    tm = np.zeros((n_configs * n_folds, n_pad), np.float32)
+    vm = np.zeros((n_configs * n_folds, n_pad), np.float32)
+    for ci in range(n_configs):
+        for ki in range(n_folds):
+            b = ci * n_folds + ki
+            tm[b, :n] = fold_masks[ki]
+            vm[b, :n] = ~fold_masks[ki]
+    n_in_fold = tm.sum(axis=1).astype(np.float32)
+
+    def rep(vals):
+        return jnp.asarray(np.repeat(np.asarray(vals, np.float32), n_folds))
+
+    hyper_b = HyperScalars(
+        learning_rate=rep([p.learning_rate for p in param_list]),
+        lambda_l1=rep([p.lambda_l1 for p in param_list]),
+        lambda_l2=rep([p.lambda_l2 for p in param_list]),
+        min_data_in_leaf=rep([p.min_data_in_leaf for p in param_list]),
+        min_sum_hessian=rep([p.min_sum_hessian_in_leaf for p in param_list]),
+        min_gain_to_split=rep([p.min_gain_to_split for p in param_list]),
+        max_depth=rep([p.max_depth for p in param_list]).astype(jnp.int32),
+        feature_fraction_bynode=rep(
+            [p.feature_fraction_bynode for p in param_list]),
+    )
+    bag_frac_b = rep([p.bagging_fraction for p in param_list])
+    ff_b = rep([p.feature_fraction for p in param_list])
+
+    # all configs in a bucket share bagging_freq (bucketing key) — LightGBM's
+    # grid fixes it at 4 anyway (r/gridsearchCV.R:98)
+    bagging_freq = p0.bagging_freq if p0.bagging_fraction < 1.0 or any(
+        p.bagging_fraction < 1.0 for p in param_list) else 0
+
+    from ..objectives import create_objective
+
+    obj = create_objective(p0)
+    y_host = train_set.get_label()
+    w_host = (train_set.get_weight() if train_set.get_weight() is not None
+              else np.ones(n))
+    if hasattr(obj, "prepare"):
+        obj.prepare(y_host, w_host)
+    init = float(obj.init_score(y_host, w_host))
+
+    run_segment, init_carry, finalize = _fused_cv_fn(
+        _objective_static_key(obj, p0), p0.num_leaves, train_set.num_bins,
+        metric_name, float(p0.alpha), num_boost_round, int(bagging_freq),
+        n_configs, n_folds, p0.extra.get("hist_impl", "auto"),
+        int(p0.extra.get("row_chunk", 131072)))
+
+    tm_d = jnp.asarray(tm)
+    carry = init_carry(n_pad, jnp.full((n_configs * n_folds,), init,
+                                       jnp.float32))
+    carry = carry._replace(bag=tm_d)
+    args = (tm_d, jnp.asarray(vm), hyper_b, bag_frac_b, ff_b,
+            jnp.asarray(n_in_fold), jnp.int32(early_stopping_rounds),
+            jax.random.PRNGKey(seed))
+    seg = int(p0.extra.get("cv_segment_rounds", 100))
+    for seg_end in range(seg, num_boost_round + seg, seg):
+        carry = run_segment(carry, jnp.int32(min(seg_end, num_boost_round)),
+                            train_set.X_binned, train_set.y, train_set.w,
+                            *args)
+        if bool(jnp.all(carry.done)) or int(carry.r) >= num_boost_round:
+            break
+    res = finalize(carry)
+    return (np.asarray(res.history), np.asarray(res.best_iter),
+            np.asarray(res.best_score), int(res.rounds_run), metric_name)

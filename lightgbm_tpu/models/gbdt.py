@@ -1,0 +1,504 @@
+"""GBDT boosting engine and the `Booster` class.
+
+TPU-native replacement for LightGBM's ``GBDT::TrainOneIter`` driver
+(SURVEY.md §3.1): one boosting round = one jitted device program
+(grad/hess -> bagging-masked stats -> best-first tree growth -> train-score
+update), driven by a host loop that only syncs for early stopping / logging.
+
+Compilation strategy: the round step is cached per *static* configuration
+(objective, num_leaves, num_bins, ...) at module level, while every
+continuous hyper-parameter (learning_rate, lambda_l1/l2, min_data_in_leaf,
+fractions, max_depth) is a traced scalar.  A 108-config sweep with three
+distinct ``num_leaves`` values therefore compiles exactly three programs
+(SURVEY.md §3.3 TPU mapping), and configs can later be vmapped.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..config import Params, default_metric_for_objective, parse_params
+from ..dataset import Dataset
+from ..metrics import get_metric
+from ..objectives import Objective, create_objective
+from ..ops.predict import predict_forest_binned, predict_tree_binned
+from ..ops.split import SplitContext
+from .tree import Tree, grow_tree
+
+
+class HyperScalars(NamedTuple):
+    """Traced per-config scalars fed to the jitted round step."""
+
+    learning_rate: jnp.ndarray
+    lambda_l1: jnp.ndarray
+    lambda_l2: jnp.ndarray
+    min_data_in_leaf: jnp.ndarray
+    min_sum_hessian: jnp.ndarray
+    min_gain_to_split: jnp.ndarray
+    max_depth: jnp.ndarray
+    feature_fraction_bynode: jnp.ndarray
+
+    @staticmethod
+    def from_params(p: Params) -> "HyperScalars":
+        return HyperScalars(
+            learning_rate=jnp.float32(p.learning_rate),
+            lambda_l1=jnp.float32(p.lambda_l1),
+            lambda_l2=jnp.float32(p.lambda_l2),
+            min_data_in_leaf=jnp.float32(p.min_data_in_leaf),
+            min_sum_hessian=jnp.float32(p.min_sum_hessian_in_leaf),
+            min_gain_to_split=jnp.float32(p.min_gain_to_split),
+            max_depth=jnp.int32(p.max_depth),
+            feature_fraction_bynode=jnp.float32(p.feature_fraction_bynode),
+        )
+
+    def ctx(self) -> SplitContext:
+        return SplitContext(
+            lambda_l1=self.lambda_l1,
+            lambda_l2=self.lambda_l2,
+            min_data_in_leaf=self.min_data_in_leaf,
+            min_sum_hessian=self.min_sum_hessian,
+            min_gain_to_split=self.min_gain_to_split,
+        )
+
+
+def _objective_static_key(obj: Objective, p: Params) -> tuple:
+    """Hashable key identifying the objective for the jit-compile cache.
+
+    The custom-loss callable rides in the key itself (callables hash by
+    identity), so user fobj objectives get their own cached program instead
+    of crashing the rebuild path.
+    """
+    return (
+        obj.name,
+        p.sigmoid,
+        getattr(obj, "pos_weight", 1.0),
+        p.alpha,
+        p.fair_c,
+        p.poisson_max_delta_step,
+        p.lambdarank_truncation_level,
+        p.lambdarank_norm,
+        p.num_class,
+        p.extra.get("fobj"),
+    )
+
+
+def _rebuild_objective(key: tuple) -> Objective:
+    (name, sigmoid, pos_weight, alpha, fair_c, pmd, trunc, norm, num_class,
+     fobj) = (key + (None,))[:10]
+    p = Params(
+        objective="none" if fobj is not None else name,
+        sigmoid=sigmoid, alpha=alpha, fair_c=fair_c,
+        poisson_max_delta_step=pmd, lambdarank_truncation_level=trunc,
+        lambdarank_norm=norm, num_class=max(num_class, 1),
+    )
+    if fobj is not None:
+        p.extra["fobj"] = fobj
+    obj = create_objective(p)
+    if hasattr(obj, "pos_weight"):
+        obj.pos_weight = pos_weight
+    return obj
+
+
+@functools.lru_cache(maxsize=None)
+def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
+              hist_impl: str, row_chunk: int, is_rf: bool):
+    obj = _rebuild_objective(obj_key)
+
+    @jax.jit
+    def round_fn(bins, y, w, bag, pred, feature_mask, hyper: HyperScalars,
+                 key):
+        g, h = obj.grad_hess(pred, y, w)
+        stats = jnp.stack([g * bag, h * bag, bag], axis=-1)
+        tree, row_leaf = grow_tree(
+            bins, stats, feature_mask, hyper.ctx(), num_leaves, num_bins,
+            hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
+            key=key, hist_impl=hist_impl, row_chunk=row_chunk)
+        shrink = jnp.where(is_rf, 1.0, hyper.learning_rate)
+        new_pred = pred + shrink * tree.leaf_value[row_leaf]
+        return tree, new_pred
+
+    return round_fn
+
+
+@functools.lru_cache(maxsize=None)
+def _tree_pred_fn(depth_cap: int):
+    @jax.jit
+    def add_tree(pred, tree, bins, shrink):
+        return pred + shrink * predict_tree_binned(tree, bins, depth_cap)
+
+    return add_tree
+
+
+@functools.lru_cache(maxsize=None)
+def _eval_fn(obj_key: tuple, metric_names: tuple, metric_cfg: tuple):
+    obj = _rebuild_objective(obj_key)
+    p = Params(alpha=metric_cfg[0]) if metric_cfg else Params()
+    metrics = [get_metric(m, p) for m in metric_names]
+
+    @jax.jit
+    def evaluate(pred_raw, y, w):
+        t = obj.transform(pred_raw)
+        return tuple(m.fn(t, y, w) for m in metrics)
+
+    return evaluate
+
+
+@functools.lru_cache(maxsize=None)
+def _bag_fn():
+    from ..ops.sampling import sample_bag
+
+    return jax.jit(sample_bag)
+
+
+@functools.lru_cache(maxsize=None)
+def _feature_mask_fn(num_features: int):
+    from ..ops.sampling import sample_feature_mask
+
+    @jax.jit
+    def sample_features(key, fraction):
+        return sample_feature_mask(key, fraction, num_features)
+
+    return sample_features
+
+
+class Booster:
+    """LightGBM-compatible Booster driving the jitted TPU round step.
+
+    Reference API surface exercised: construction via ``lgb.train`` with a
+    Dataset (r/gridsearchCV.R:57), ``predict`` over all or first-k trees
+    (r/gridsearchCV.R:63, bagging_boosting.ipynb:136).
+    """
+
+    def __init__(self, params: Optional[Union[Dict[str, Any], Params]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        if model_file is not None or model_str is not None:
+            from ..utils.serialize import load_booster_into
+            load_booster_into(self, model_file=model_file, model_str=model_str)
+            return
+        if isinstance(params, Params):
+            self.params = params
+        else:
+            self.params = parse_params(params)
+        self.train_set = train_set
+        self.obj = create_objective(self.params)
+        self.trees: List[Tree] = []
+        self._forest_cache: Optional[Tree] = None
+        self.best_iteration: int = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._valid: List[Tuple[str, Dataset, Any]] = []  # (name, dataset, pred)
+        self._iter = 0
+        self.init_score_ = 0.0
+        self._pred_train = None
+        self._bag = None
+        self._key = jax.random.PRNGKey(self.params.seed)
+
+        if train_set is not None:
+            self._setup_training()
+
+    # ------------------------------------------------------------------
+    def _setup_training(self) -> None:
+        ds = self.train_set
+        ds.construct()
+        if ds.y is None:
+            raise ValueError("training Dataset requires a label")
+        p = self.params
+        y_host = ds.get_label()
+        w_host = (ds.get_weight() if ds.get_weight() is not None
+                  else np.ones(ds.num_data_))
+        if hasattr(self.obj, "prepare"):
+            self.obj.prepare(y_host, w_host)
+        self.init_score_ = float(self.obj.init_score(y_host, w_host))
+        if ds.get_init_score() is not None:
+            base = np.concatenate([
+                np.asarray(ds.get_init_score(), np.float32),
+                np.zeros(int(ds.row_mask.shape[0]) - ds.num_data_, np.float32)])
+            self._pred_train = jnp.asarray(base)
+            self.init_score_ = 0.0
+        else:
+            self._pred_train = jnp.full(
+                ds.row_mask.shape, self.init_score_, jnp.float32)
+        self._bag = ds.row_mask
+        self._hyper = HyperScalars.from_params(p)
+        self._obj_key = _objective_static_key(self.obj, p)
+        self._num_bins = ds.num_bins
+        self._w_eff = ds.w  # 0 on padding rows already
+
+    # -- round step ------------------------------------------------------
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """Run one boosting round (LightGBM Booster.update)."""
+        if train_set is not None and train_set is not self.train_set:
+            self.train_set = train_set
+            self._setup_training()
+        ds = self.train_set
+        p = self.params
+        i = self._iter
+
+        if p.bagging_freq > 0 and p.bagging_fraction < 1.0 and \
+                i % p.bagging_freq == 0:
+            bkey = jax.random.fold_in(
+                jax.random.PRNGKey(p.bagging_seed + p.seed), i)
+            self._bag = _bag_fn()(
+                bkey, ds.row_mask, jnp.float32(p.bagging_fraction),
+                jnp.float32(ds.num_data_))
+        if p.feature_fraction < 1.0:
+            fkey = jax.random.fold_in(
+                jax.random.PRNGKey(p.feature_fraction_seed + p.seed), i)
+            fmask = _feature_mask_fn(ds.num_feature_)(
+                fkey, jnp.float32(p.feature_fraction))
+        else:
+            fmask = jnp.ones(ds.num_feature_, jnp.float32)
+
+        fn = _round_fn(self._obj_key, p.num_leaves, self._num_bins,
+                       p.extra.get("hist_impl", "auto"),
+                       int(p.extra.get("row_chunk", 131072)),
+                       p.boosting == "rf")
+        round_key = jax.random.fold_in(self._key, i)
+        tree, new_pred = fn(ds.X_binned, ds.y, self._w_eff, self._bag,
+                            self._pred_train, fmask, self._hyper, round_key)
+        if p.boosting != "rf":
+            self._pred_train = new_pred
+        self.trees.append(tree)
+        self._forest_cache = None
+        # incremental valid-set predictions
+        shrink = 1.0 if p.boosting == "rf" else p.learning_rate
+        add_tree = _tree_pred_fn(p.num_leaves)
+        for idx, (name, vds, vpred) in enumerate(self._valid):
+            self._valid[idx] = (
+                name, vds, add_tree(vpred, tree, vds.X_binned,
+                                    jnp.float32(shrink)))
+        self._iter += 1
+        return False
+
+    # -- evaluation ------------------------------------------------------
+    def _metric_names(self) -> List[str]:
+        names = [m for m in self.params.metric if m != "none"]
+        if not names:
+            default = default_metric_for_objective(self.params.objective)
+            if default != "none":
+                names = [default]
+        return names
+
+    def _eval_on(self, pred_raw, ds: Dataset, name: str):
+        metric_names = tuple(self._metric_names())
+        if not metric_names:
+            return []
+        fn = _eval_fn(self._obj_key, metric_names, (self.params.alpha,))
+        vals = fn(pred_raw, ds.y, ds.w)
+        out = []
+        for mname, v in zip(metric_names, vals):
+            m = get_metric(mname, self.params)
+            out.append((name, mname, float(v), m.higher_better))
+        return out
+
+    def eval_train(self, feval=None):
+        pred = self._pred_train_effective()
+        res = self._eval_on(pred, self.train_set, "training")
+        return res + self._feval_results(feval, pred, self.train_set,
+                                         "training")
+
+    def eval_valid(self, feval=None):
+        out = []
+        for name, vds, vpred in self._valid:
+            vp = self._rf_scale(vpred)
+            out.extend(self._eval_on(vp, vds, name))
+            out.extend(self._feval_results(feval, vp, vds, name))
+        return out
+
+    def _feval_results(self, feval, pred_raw, ds, name):
+        if feval is None:
+            return []
+        fevals = feval if isinstance(feval, (list, tuple)) else [feval]
+        out = []
+        n = ds.num_data_
+        pred_host = np.asarray(self.obj.transform(pred_raw))[:n]
+        for f in fevals:
+            mname, val, hib = f(pred_host, ds)
+            out.append((name, mname, float(val), bool(hib)))
+        return out
+
+    def _rf_scale(self, pred_raw):
+        if self.params.boosting == "rf" and self._iter > 0:
+            return (pred_raw - self.init_score_) / self._iter + self.init_score_
+        return pred_raw
+
+    def _pred_train_effective(self):
+        if self.params.boosting == "rf":
+            # rf keeps _pred_train at init; reconstruct mean over trees lazily
+            if not self.trees:
+                return self._pred_train
+            forest = self._stacked_forest()
+            pred = predict_forest_binned(
+                forest, self.train_set.X_binned, 1.0 / self._iter,
+                self.init_score_, jnp.int32(self._iter), self.params.num_leaves)
+            return pred
+        return self._pred_train
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.construct()
+        if data.y is None:
+            raise ValueError(f"valid set '{name}' requires a label")
+        vpred = jnp.full(data.row_mask.shape, self.init_score_, jnp.float32)
+        # replay existing trees (valid sets are usually added before round 0)
+        shrink = 1.0 if self.params.boosting == "rf" else self.params.learning_rate
+        add_tree = _tree_pred_fn(self.params.num_leaves)
+        for tree in self.trees:
+            vpred = add_tree(vpred, tree, data.X_binned, jnp.float32(shrink))
+        self._valid.append((name, data, vpred))
+        return self
+
+    # -- prediction ------------------------------------------------------
+    def _stacked_forest(self) -> Tree:
+        if self._forest_cache is None or \
+                self._forest_cache.leaf_value.shape[0] != len(self.trees):
+            if not self.trees:
+                raise ValueError("no trees trained yet")
+            self._forest_cache = jax.tree.map(
+                lambda *xs: jnp.stack(xs), *self.trees)
+        return self._forest_cache
+
+    def predict(
+        self,
+        data,
+        num_iteration: Optional[int] = None,
+        raw_score: bool = False,
+        pred_leaf: bool = False,
+        start_iteration: int = 0,
+        ntree_limit: Optional[int] = None,  # xgboost-style alias
+        **kwargs,
+    ) -> np.ndarray:
+        """Predict on raw (unbinned) features.
+
+        ``num_iteration``/``ntree_limit`` truncate to the first k trees —
+        the staged-prediction contract of bagging_boosting.ipynb:136.
+        """
+        if num_iteration is None:
+            num_iteration = ntree_limit
+        if num_iteration is None:
+            # None -> best_iteration when early stopping found one
+            num_iteration = (self.best_iteration
+                             if self.best_iteration > 0 else len(self.trees))
+        elif num_iteration <= 0:
+            # explicit <= 0 -> ALL trees (LightGBM contract)
+            num_iteration = len(self.trees)
+        start_iteration = max(int(start_iteration), 0)
+        num_iteration = min(num_iteration, len(self.trees) - start_iteration)
+        if isinstance(data, Dataset):
+            raise TypeError(
+                "predict() expects a raw feature matrix, not a Dataset "
+                "(matching lightgbm)")
+        from ..dataset import _to_2d_float_array
+        X = _to_2d_float_array(data)
+        codes = self._bin_mapper_for_predict().transform(X)
+        bins = jnp.asarray(codes)
+        forest = self._stacked_forest()
+        if pred_leaf:
+            leaves = []
+            for t in range(start_iteration, start_iteration + num_iteration):
+                tree = jax.tree.map(lambda a: a[t], forest)
+                node = self._leaf_index(tree, bins)
+                leaves.append(np.asarray(node))
+            return np.stack(leaves, axis=1)
+        shrink = 1.0 if self.params.boosting == "rf" else self.params.learning_rate
+        raw = predict_forest_binned(
+            forest, bins, jnp.float32(shrink), self.init_score_,
+            jnp.int32(num_iteration), self.params.num_leaves,
+            start_iteration=jnp.int32(start_iteration))
+        if self.params.boosting == "rf" and num_iteration > 0:
+            raw = (raw - self.init_score_) / num_iteration + self.init_score_
+        if raw_score:
+            return np.asarray(raw)
+        return np.asarray(self.obj.transform(raw))
+
+    def _leaf_index(self, tree: Tree, bins) -> jnp.ndarray:
+        from jax import lax
+
+        n = bins.shape[0]
+        b32 = bins.astype(jnp.int32)
+
+        def step(node, _):
+            feat = tree.split_feature[node]
+            thr = tree.split_bin[node]
+            code = jnp.take_along_axis(b32, feat[:, None], axis=1)[:, 0]
+            nxt = jnp.where(code <= thr, tree.left[node], tree.right[node])
+            return jnp.where(tree.is_leaf[node], node, nxt), None
+
+        node, _ = lax.scan(step, jnp.zeros(n, jnp.int32), None,
+                           length=self.params.num_leaves)
+        return node
+
+    def _bin_mapper_for_predict(self):
+        if self.train_set is not None:
+            return self.train_set.bin_mapper
+        return self._bin_mapper  # loaded from a model file
+
+    # -- introspection ---------------------------------------------------
+    def current_iteration(self) -> int:
+        return self._iter
+
+    def num_trees(self) -> int:
+        return len(self.trees)
+
+    def num_feature(self) -> int:
+        if self.train_set is not None:
+            return self.train_set.num_feature()
+        return self._bin_mapper.num_features
+
+    def feature_name(self) -> List[str]:
+        if self.train_set is not None:
+            return list(self.train_set.feature_names)
+        return list(self._feature_names or [])
+
+    def num_model_per_iteration(self) -> int:
+        return 1
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        k = iteration or len(self.trees)
+        out = np.zeros(self.num_feature(), dtype=np.float64)
+        for tree in self.trees[:k]:
+            feats = np.asarray(tree.split_feature)
+            gains = np.asarray(tree.split_gain)
+            internal = np.asarray(~tree.is_leaf) & (feats >= 0)
+            for f, g, used in zip(feats, gains, internal):
+                if used:
+                    out[f] += 1.0 if importance_type == "split" else float(g)
+        if importance_type == "split":
+            return out.astype(np.int64 if importance_type == "split" else np.float64)
+        return out
+
+    def rollback_one_iter(self) -> "Booster":
+        if self.trees:
+            tree = self.trees.pop()
+            self._forest_cache = None
+            self._iter -= 1
+            is_rf = self.params.boosting == "rf"
+            shrink = jnp.float32(1.0 if is_rf else self.params.learning_rate)
+            add = _tree_pred_fn(self.params.num_leaves)
+            if not is_rf:  # rf keeps _pred_train at init score
+                self._pred_train = add(
+                    self._pred_train, tree, self.train_set.X_binned, -shrink)
+            for idx, (name, vds, vpred) in enumerate(self._valid):
+                self._valid[idx] = (
+                    name, vds, add(vpred, tree, vds.X_binned, -shrink))
+        return self
+
+    # -- persistence (full model dump lands with utils.serialize) --------
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> "Booster":
+        from ..utils.serialize import save_booster
+        save_booster(self, filename, num_iteration=num_iteration,
+                     start_iteration=start_iteration)
+        return self
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0) -> str:
+        from ..utils.serialize import booster_to_string
+        return booster_to_string(self, num_iteration=num_iteration,
+                                 start_iteration=start_iteration)

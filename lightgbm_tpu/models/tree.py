@@ -1,0 +1,310 @@
+"""Tensorized leaf-wise (best-first) tree grower.
+
+TPU-native replacement for LightGBM's ``SerialTreeLearner::Train`` (SURVEY.md
+§3.1): no leaf objects, no row-index vectors, no OpenMP — the tree is a
+struct-of-arrays with a static node capacity ``2*num_leaves - 1``, rows carry a
+leaf-id vector updated by gathered split decisions, and growth is a
+``lax.fori_loop`` with exactly ``num_leaves - 1`` trips where exhausted trees
+execute masked no-ops (SURVEY.md §7 "Dynamic tree growth under static
+shapes").
+
+Best-first semantics match LightGBM: each trip splits the single active leaf
+with the highest cached split gain.  When a leaf is split, both children's
+histograms are built in **one** pass over all rows (segments = {left child,
+right child}; other rows contribute nothing), so no per-node histogram storage
+and no subtraction trick is needed — under static shapes a one-child pass
+costs the same as a two-child pass, and dropping stored histograms keeps
+memory at O(num_leaves) scalars per node, which is what lets folds × configs
+be vmapped later.
+
+Everything data-dependent stays on device; all regularization thresholds are
+traced scalars (vmap-able across hyper-parameter configs).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.histogram import compute_histograms, histogram_psum
+from ..ops.split import (
+    BestSplit,
+    SplitContext,
+    find_best_split,
+    leaf_output,
+)
+
+
+class Tree(NamedTuple):
+    """One tensorized decision tree (node arrays of length 2*num_leaves-1).
+
+    Traversal rule at internal node i: go left iff
+    ``bin_code[row, split_feature[i]] <= split_bin[i]``.
+    Unused slots have ``is_leaf=False`` and are unreachable.
+    """
+
+    split_feature: jnp.ndarray  # i32[M]
+    split_bin: jnp.ndarray      # i32[M]
+    left: jnp.ndarray           # i32[M]
+    right: jnp.ndarray          # i32[M]
+    leaf_value: jnp.ndarray     # f32[M] (raw, no shrinkage)
+    is_leaf: jnp.ndarray        # bool[M]
+    count: jnp.ndarray          # f32[M] rows that reached the node (bagged)
+    split_gain: jnp.ndarray     # f32[M] gain of the split at internal nodes
+    num_leaves: jnp.ndarray     # i32[] leaves actually grown
+
+    @property
+    def capacity(self) -> int:
+        return self.split_feature.shape[-1]
+
+
+class _GrowState(NamedTuple):
+    # tree under construction
+    split_feature: jnp.ndarray
+    split_bin: jnp.ndarray
+    left: jnp.ndarray
+    right: jnp.ndarray
+    leaf_value: jnp.ndarray
+    is_leaf: jnp.ndarray
+    count: jnp.ndarray
+    split_gain: jnp.ndarray
+    depth: jnp.ndarray          # i32[M]
+    # cached best candidate split per created node
+    cand_gain: jnp.ndarray      # f32[M] (-inf when invalid)
+    cand_feat: jnp.ndarray      # i32[M]
+    cand_bin: jnp.ndarray       # i32[M]
+    cand_lg: jnp.ndarray
+    cand_lh: jnp.ndarray
+    cand_lc: jnp.ndarray
+    cand_rg: jnp.ndarray
+    cand_rh: jnp.ndarray
+    cand_rc: jnp.ndarray
+    # dynamic growth state
+    row_leaf: jnp.ndarray       # i32[n]
+    n_nodes: jnp.ndarray        # i32[]
+    n_leaves: jnp.ndarray       # i32[]
+    done: jnp.ndarray           # bool[]
+
+
+def _write(arr, idx, val, active):
+    """Masked scalar write arr[idx] = val if active."""
+    return arr.at[idx].set(jnp.where(active, val, arr[idx]))
+
+
+def grow_tree(
+    bins: jnp.ndarray,
+    stats: jnp.ndarray,
+    feature_mask: jnp.ndarray,
+    ctx: SplitContext,
+    num_leaves: int,
+    num_bins: int,
+    max_depth,
+    ff_bynode=None,
+    key: Optional[jnp.ndarray] = None,
+    axis_name: Optional[str] = None,
+    hist_impl: str = "auto",
+    row_chunk: int = 131072,
+) -> Tuple[Tree, jnp.ndarray]:
+    """Grow one best-first tree.
+
+    Args:
+      bins: uint8/int32 ``[n, F]`` binned features (full, static shape; rows
+        not in this tree's bag simply carry zero stats).
+      stats: f32 ``[n, 3]`` of (grad, hess, in-bag indicator).  grad/hess must
+        already include sample weights and bagging mask; padding rows all-zero.
+      feature_mask: f32 ``[F]`` — 1 for features usable this tree.
+      ctx: traced regularization scalars.
+      num_leaves: static leaf budget (r/gridsearchCV.R:96 grid axis).
+      num_bins: static histogram bin-axis size.
+      max_depth: traced i32; <= 0 means unlimited (LightGBM default -1).
+      ff_bynode: traced per-node feature-sampling fraction (LightGBM
+        ``feature_fraction_bynode`` — sklearn RandomForest's per-split
+        ``max_features``); None/1.0 disables sampling.
+      key: PRNG key for per-node sampling (folded with the node id, so the
+        sampled set differs per node but is deterministic under the seed).
+      axis_name: if set, per-shard histograms are psum-merged over this mesh
+        axis — the data-parallel tree learner (SURVEY.md §2C).
+
+    Returns:
+      (Tree, row_leaf) — row_leaf gives each training row's final leaf node id
+      so the boosting loop can update train predictions with one gather.
+    """
+    n, num_features = bins.shape
+    capacity = 2 * num_leaves - 1
+    max_depth = jnp.asarray(max_depth, jnp.int32)
+    neg_inf = jnp.float32(-jnp.inf)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    if ff_bynode is None:
+        ff_bynode = jnp.float32(1.0)
+
+    def node_feature_mask(node_id):
+        """Per-node column subsample drawn WITHIN the per-tree subset
+        (LightGBM samples bynode from the tree-sampled set, so a node can
+        never end up with zero usable features)."""
+        from ..ops.sampling import sample_feature_mask
+
+        return sample_feature_mask(jax.random.fold_in(key, node_id),
+                                   ff_bynode, num_features,
+                                   base_mask=feature_mask)
+
+    def hist_fn(seg_id, num_segments):
+        h = compute_histograms(
+            bins, stats, seg_id, num_segments, num_bins,
+            row_chunk=row_chunk, impl=hist_impl)
+        return histogram_psum(h, axis_name)
+
+    # ---- root -------------------------------------------------------------
+    root_hist = hist_fn(jnp.zeros(n, jnp.int32), 1)[0]          # [F, B, 3]
+    root_tot = jnp.sum(root_hist[0], axis=0)                     # (g, h, c)
+    # LightGBM convention: max_depth <= 0 means unlimited, so the root
+    # (depth 0) is always splittable — if a limit exists it is >= 1.
+    root_best = find_best_split(root_hist, ctx, node_feature_mask(0),
+                                jnp.bool_(True))
+
+    def full(val, dtype):
+        return jnp.full((capacity,), val, dtype)
+
+    st = _GrowState(
+        split_feature=full(-1, jnp.int32),
+        split_bin=full(0, jnp.int32),
+        left=full(-1, jnp.int32),
+        right=full(-1, jnp.int32),
+        leaf_value=full(0.0, jnp.float32).at[0].set(
+            leaf_output(root_tot[0], root_tot[1], ctx)),
+        is_leaf=full(False, jnp.bool_).at[0].set(True),
+        count=full(0.0, jnp.float32).at[0].set(root_tot[2]),
+        split_gain=full(0.0, jnp.float32),
+        depth=full(0, jnp.int32),
+        cand_gain=full(neg_inf, jnp.float32).at[0].set(root_best.gain),
+        cand_feat=full(0, jnp.int32).at[0].set(root_best.feature),
+        cand_bin=full(0, jnp.int32).at[0].set(root_best.bin),
+        cand_lg=full(0.0, jnp.float32).at[0].set(root_best.left_g),
+        cand_lh=full(0.0, jnp.float32).at[0].set(root_best.left_h),
+        cand_lc=full(0.0, jnp.float32).at[0].set(root_best.left_c),
+        cand_rg=full(0.0, jnp.float32).at[0].set(root_best.right_g),
+        cand_rh=full(0.0, jnp.float32).at[0].set(root_best.right_h),
+        cand_rc=full(0.0, jnp.float32).at[0].set(root_best.right_c),
+        row_leaf=jnp.zeros(n, jnp.int32),
+        n_nodes=jnp.int32(1),
+        n_leaves=jnp.int32(1),
+        done=jnp.bool_(False),
+    )
+
+    bins_i32 = bins.astype(jnp.int32)
+
+    def body(_, st: _GrowState) -> _GrowState:
+        # 1. pick the active leaf with the best cached gain (best-first).
+        gains = jnp.where(st.is_leaf, st.cand_gain, neg_inf)
+        leaf = jnp.argmax(gains).astype(jnp.int32)
+        gain = gains[leaf]
+        active = (~st.done) & jnp.isfinite(gain)
+
+        nl = st.n_nodes
+        nr = st.n_nodes + 1
+        feat = st.cand_feat[leaf]
+        thr = st.cand_bin[leaf]
+
+        # 2. partition rows of the split leaf (gather, no pointer chasing).
+        col = jnp.take(bins_i32, feat, axis=1)
+        go_left = col <= thr
+        new_rl = jnp.where(
+            st.row_leaf == leaf, jnp.where(go_left, nl, nr), st.row_leaf)
+        row_leaf = jnp.where(active, new_rl, st.row_leaf)
+
+        # 3. both children's histograms in one pass (others -> segment 2).
+        seg = jnp.where(row_leaf == nl, 0,
+                        jnp.where(row_leaf == nr, 1, 2)).astype(jnp.int32)
+        hist2 = hist_fn(seg, 2)                                  # [2, F, B, 3]
+
+        # 4. candidate splits for the children (each child samples its own
+        # per-node feature subset when feature_fraction_bynode < 1).
+        child_depth = st.depth[leaf] + 1
+        depth_ok = (max_depth <= 0) | (child_depth < max_depth)
+        child_masks = jnp.stack([node_feature_mask(nl), node_feature_mask(nr)])
+        bs: BestSplit = jax.vmap(
+            find_best_split, in_axes=(0, None, 0, None))(
+                hist2, ctx, child_masks, depth_ok)
+
+        lg, lh, lc = st.cand_lg[leaf], st.cand_lh[leaf], st.cand_lc[leaf]
+        rg, rh, rc = st.cand_rg[leaf], st.cand_rh[leaf], st.cand_rc[leaf]
+
+        new = st._replace(
+            split_feature=_write(st.split_feature, leaf, feat, active),
+            split_bin=_write(st.split_bin, leaf, thr, active),
+            left=_write(st.left, leaf, nl, active),
+            right=_write(st.right, leaf, nr, active),
+            split_gain=_write(st.split_gain, leaf, gain, active),
+            is_leaf=_write(
+                _write(_write(st.is_leaf, leaf, False, active),
+                       nl, True, active),
+                nr, True, active),
+            leaf_value=_write(
+                _write(st.leaf_value, nl, leaf_output(lg, lh, ctx), active),
+                nr, leaf_output(rg, rh, ctx), active),
+            count=_write(_write(st.count, nl, lc, active), nr, rc, active),
+            depth=_write(_write(st.depth, nl, child_depth, active),
+                         nr, child_depth, active),
+            cand_gain=_write(_write(st.cand_gain, nl, bs.gain[0], active),
+                             nr, bs.gain[1], active),
+            cand_feat=_write(_write(st.cand_feat, nl, bs.feature[0], active),
+                             nr, bs.feature[1], active),
+            cand_bin=_write(_write(st.cand_bin, nl, bs.bin[0], active),
+                            nr, bs.bin[1], active),
+            cand_lg=_write(_write(st.cand_lg, nl, bs.left_g[0], active),
+                           nr, bs.left_g[1], active),
+            cand_lh=_write(_write(st.cand_lh, nl, bs.left_h[0], active),
+                           nr, bs.left_h[1], active),
+            cand_lc=_write(_write(st.cand_lc, nl, bs.left_c[0], active),
+                           nr, bs.left_c[1], active),
+            cand_rg=_write(_write(st.cand_rg, nl, bs.right_g[0], active),
+                           nr, bs.right_g[1], active),
+            cand_rh=_write(_write(st.cand_rh, nl, bs.right_h[0], active),
+                           nr, bs.right_h[1], active),
+            cand_rc=_write(_write(st.cand_rc, nl, bs.right_c[0], active),
+                           nr, bs.right_c[1], active),
+            row_leaf=row_leaf,
+            n_nodes=st.n_nodes + jnp.where(active, 2, 0).astype(jnp.int32),
+            n_leaves=st.n_leaves + jnp.where(active, 1, 0).astype(jnp.int32),
+            done=st.done | ~jnp.isfinite(gain),
+        )
+        return new
+
+    st = lax.fori_loop(0, num_leaves - 1, body, st)
+
+    tree = Tree(
+        split_feature=st.split_feature,
+        split_bin=st.split_bin,
+        left=st.left,
+        right=st.right,
+        leaf_value=st.leaf_value,
+        is_leaf=st.is_leaf,
+        count=st.count,
+        split_gain=st.split_gain,
+        num_leaves=st.n_leaves,
+    )
+    return tree, st.row_leaf
+
+
+def empty_forest(num_trees: int, num_leaves: int) -> Tree:
+    """Stacked all-stump forest used as a fixed-capacity accumulator."""
+    capacity = 2 * num_leaves - 1
+
+    def full(val, dtype):
+        return jnp.full((num_trees, capacity), val, dtype)
+
+    return Tree(
+        split_feature=full(-1, jnp.int32),
+        split_bin=full(0, jnp.int32),
+        left=full(-1, jnp.int32),
+        right=full(-1, jnp.int32),
+        leaf_value=full(0.0, jnp.float32),
+        is_leaf=full(False, jnp.bool_).at[:, 0].set(True),
+        count=full(0.0, jnp.float32),
+        split_gain=full(0.0, jnp.float32),
+        num_leaves=jnp.ones((num_trees,), jnp.int32),
+    )

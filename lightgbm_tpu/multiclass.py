@@ -1,0 +1,24 @@
+"""Multiclass objective (softmax, one tree per class per round).
+
+Planned for milestone M4 (SURVEY.md §7 build order); importing it before then
+raises with a clear message rather than failing deep inside training.
+"""
+
+from __future__ import annotations
+
+from .objectives import Objective
+
+
+class Multiclass(Objective):
+    name = "multiclass"
+
+    def __init__(self, params):
+        raise NotImplementedError(
+            "multiclass objective is scheduled for milestone M4 "
+            "(K-trees-per-round boosting); binary and regression objectives "
+            "are available now")
+
+
+def get_multiclass_metric(name, params=None):
+    raise NotImplementedError(f"{name} metric lands with the multiclass "
+                              "objective (milestone M4)")

@@ -1,0 +1,211 @@
+"""Objective functions: gradients/hessians + init score + output transform.
+
+TPU-native replacement for LightGBM's ``src/objective/`` (exercised via
+``objective="regression"`` at r/gridsearchCV.R:59,74,111 and xgboost's
+``reg:linear`` at bagging_boosting.ipynb:121; SURVEY.md §2C "Boosting loop +
+objectives/metrics").  Each objective is a stateless class whose
+``grad_hess`` runs inside the jitted round step.
+
+Conventions:
+  * ``pred`` is always the raw (untransformed) score.
+  * gradients/hessians are already multiplied by the effective row weight.
+  * ``init_score`` runs on host once per training (numpy).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from .config import Params
+
+
+class Objective:
+    name = "none"
+    higher_better = False
+    needs_group = False
+
+    def __init__(self, params: Params):
+        self.params = params
+
+    def init_score(self, y: np.ndarray, w: np.ndarray) -> float:
+        return 0.0
+
+    def grad_hess(self, pred, y, w):
+        raise NotImplementedError
+
+    def transform(self, raw):
+        """Raw score -> user-facing prediction (e.g. sigmoid for binary)."""
+        return raw
+
+
+class RegressionL2(Objective):
+    name = "regression"
+
+    def init_score(self, y, w):
+        if not self.params.boost_from_average:
+            return 0.0
+        return float(np.average(y, weights=np.maximum(w, 0)))
+
+    def grad_hess(self, pred, y, w):
+        return (pred - y) * w, w
+
+
+class RegressionL1(Objective):
+    """MAE. Uses the standard constant-hessian surrogate; LightGBM additionally
+    renews leaf values with the weighted-median of residuals (upstream
+    RegressionL1loss::RenewTreeOutput) — a refinement tracked for M4."""
+
+    name = "regression_l1"
+
+    def init_score(self, y, w):
+        if not self.params.boost_from_average:
+            return 0.0
+        order = np.argsort(y)
+        cw = np.cumsum(w[order])
+        idx = np.searchsorted(cw, 0.5 * cw[-1])
+        return float(y[order][min(idx, len(y) - 1)])
+
+    def grad_hess(self, pred, y, w):
+        return jnp.sign(pred - y) * w, w
+
+
+class Huber(Objective):
+    name = "huber"
+
+    def grad_hess(self, pred, y, w):
+        delta = jnp.float32(self.params.alpha)
+        r = pred - y
+        g = jnp.clip(r, -delta, delta)
+        return g * w, w
+
+    def init_score(self, y, w):
+        if not self.params.boost_from_average:
+            return 0.0
+        return float(np.average(y, weights=np.maximum(w, 0)))
+
+
+class Fair(Objective):
+    name = "fair"
+
+    def grad_hess(self, pred, y, w):
+        c = jnp.float32(self.params.fair_c)
+        r = pred - y
+        g = c * r / (jnp.abs(r) + c)
+        h = c * c / (jnp.abs(r) + c) ** 2
+        return g * w, h * w
+
+
+class Poisson(Objective):
+    name = "poisson"
+
+    def init_score(self, y, w):
+        mean = max(np.average(y, weights=np.maximum(w, 0)), 1e-9)
+        return float(np.log(mean))
+
+    def grad_hess(self, pred, y, w):
+        mu = jnp.exp(pred)
+        h = jnp.exp(pred + jnp.float32(self.params.poisson_max_delta_step))
+        return (mu - y) * w, h * w
+
+    def transform(self, raw):
+        return jnp.exp(raw)
+
+
+class Quantile(Objective):
+    name = "quantile"
+
+    def grad_hess(self, pred, y, w):
+        alpha = jnp.float32(self.params.alpha)
+        g = jnp.where(y > pred, -alpha, 1.0 - alpha)
+        return g * w, w
+
+
+class Binary(Objective):
+    """Binary logloss on labels {0,1}; raw score is a logit.
+
+    Supports ``sigmoid`` scaling, ``scale_pos_weight`` and ``is_unbalance``
+    (positive-class reweighting) like upstream binary_objective.hpp.
+    """
+
+    name = "binary"
+
+    def __init__(self, params: Params):
+        super().__init__(params)
+        self.pos_weight = float(params.scale_pos_weight)
+
+    def prepare(self, y: np.ndarray, w: np.ndarray) -> None:
+        if self.params.is_unbalance:
+            pos = float(np.sum(w * (y > 0.5)))
+            neg = float(np.sum(w * (y <= 0.5)))
+            self.pos_weight = neg / max(pos, 1.0) if pos > 0 else 1.0
+
+    def init_score(self, y, w):
+        self.prepare(y, np.asarray(w))
+        if not self.params.boost_from_average:
+            return 0.0
+        pw = self.pos_weight
+        sw = w * np.where(y > 0.5, pw, 1.0)
+        pbar = np.average(y, weights=np.maximum(sw, 1e-12))
+        pbar = min(max(pbar, 1e-12), 1 - 1e-12)
+        return float(np.log(pbar / (1 - pbar)) / self.params.sigmoid)
+
+    def grad_hess(self, pred, y, w):
+        sig = jnp.float32(self.params.sigmoid)
+        p = jax_sigmoid(sig * pred)
+        wy = w * jnp.where(y > 0.5, jnp.float32(self.pos_weight), 1.0)
+        g = sig * (p - y)
+        h = jnp.maximum(sig * sig * p * (1.0 - p), 1e-16)
+        return g * wy, h * wy
+
+    def transform(self, raw):
+        return jax_sigmoid(jnp.float32(self.params.sigmoid) * raw)
+
+
+def jax_sigmoid(x):
+    return 1.0 / (1.0 + jnp.exp(-x))
+
+
+class CustomObjective(Objective):
+    """Wraps a user fobj(preds, train_data)-style callable (lgb custom loss)."""
+
+    name = "custom"
+
+    def __init__(self, params: Params, fobj: Callable):
+        super().__init__(params)
+        self.fobj = fobj
+
+    def grad_hess(self, pred, y, w):
+        g, h = self.fobj(pred, y)
+        return g * w, h * w
+
+
+_REGISTRY: Dict[str, type] = {
+    "regression": RegressionL2,
+    "regression_l1": RegressionL1,
+    "huber": Huber,
+    "fair": Fair,
+    "poisson": Poisson,
+    "quantile": Quantile,
+    "binary": Binary,
+}
+
+
+def create_objective(params: Params) -> Objective:
+    fobj = params.extra.get("fobj")
+    if fobj is not None or params.objective == "none":
+        if fobj is None:
+            raise ValueError("objective='none' requires a custom fobj")
+        return CustomObjective(params, fobj)
+    if params.objective in ("multiclass", "multiclassova"):
+        from .multiclass import Multiclass  # deferred: optional heavy path
+        return Multiclass(params)
+    if params.objective == "lambdarank":
+        from .ranking import LambdaRank
+        return LambdaRank(params)
+    cls = _REGISTRY.get(params.objective)
+    if cls is None:
+        raise ValueError(f"Unsupported objective: {params.objective}")
+    return cls(params)

@@ -1,0 +1,25 @@
+"""Core TPU compute ops: histogram construction, split search, traversal."""
+
+from .histogram import compute_histograms, histogram_psum
+from .split import (
+    BestSplit,
+    SplitContext,
+    find_best_split,
+    leaf_objective,
+    leaf_output,
+    threshold_l1,
+)
+from .predict import predict_forest_binned, predict_tree_binned
+
+__all__ = [
+    "compute_histograms",
+    "histogram_psum",
+    "BestSplit",
+    "SplitContext",
+    "find_best_split",
+    "leaf_objective",
+    "leaf_output",
+    "threshold_l1",
+    "predict_forest_binned",
+    "predict_tree_binned",
+]

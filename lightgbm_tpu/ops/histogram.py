@@ -1,0 +1,122 @@
+"""Gradient/hessian histogram construction — the GBDT hot loop.
+
+This is the TPU-native replacement for LightGBM's OpenMP histogram
+construction (upstream ``src/treelearner/``, exercised by every ``lgb.train`` /
+``lgb.cv`` call in the reference — SURVEY.md §2C row "Histogram construction
+hot loop").
+
+Formulation: scatter-add is slow on TPU, so the histogram is computed as a
+one-hot **matmul** that runs on the MXU:
+
+    hist[b, k] = sum_n  onehot(bin[n] == b) * segstats[n, k]
+
+where ``segstats`` folds the (segment × statistic) axes together; segments are
+tree leaves (or CV folds × leaves later).  Features are processed by a
+``lax.scan`` so only one [rows, bins] one-hot is live at a time, and rows are
+chunked so peak memory stays bounded for multi-million-row data.
+
+A Pallas kernel with the same signature (one-hot built tile-by-tile in VMEM,
+never materialized in HBM) lives in ``histogram_pallas.py`` and is selected
+via ``ops.histogram.compute_histograms(..., impl=...)``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DEFAULT_ROW_CHUNK = 131072
+
+
+def _hist_one_chunk(bins_c: jnp.ndarray, segstats_c: jnp.ndarray, num_bins: int):
+    """bins_c: i32[nc, F]; segstats_c: f32[nc, K] -> f32[F, num_bins, K]."""
+
+    def per_feature(_, bins_f):
+        onehot = (bins_f[:, None] == lax.iota(jnp.int32, num_bins)[None, :])
+        onehot = onehot.astype(segstats_c.dtype)
+        # [num_bins, nc] @ [nc, K] -> [num_bins, K]  (MXU).  HIGHEST keeps
+        # full f32 accumulation: split gains are differences of large sums
+        # and bf16-quantized inputs visibly corrupt them.
+        h = jnp.einsum(
+            "nb,nk->bk", onehot, segstats_c,
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST)
+        return _, h
+
+    _, hists = lax.scan(per_feature, None, bins_c.T)  # [F, B, K]
+    return hists
+
+
+def compute_histograms(
+    bins: jnp.ndarray,
+    stats: jnp.ndarray,
+    seg_id: jnp.ndarray,
+    num_segments: int,
+    num_bins: int,
+    row_chunk: int = DEFAULT_ROW_CHUNK,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Histogram of per-row statistics over (segment, feature, bin).
+
+    Args:
+      bins: uint8/int32 ``[n, F]`` bin codes.
+      stats: f32 ``[n, S]`` per-row statistics (grad, hess, count-mask, ...).
+        Rows excluded from the histogram (padding, bagged-out) must carry
+        zero stats *or* an out-of-range ``seg_id``.
+      seg_id: int32 ``[n]`` segment of each row; values outside
+        ``[0, num_segments)`` contribute nothing.
+      num_segments: static segment count (e.g. 2 for the two fresh children).
+      num_bins: static bin-axis size.
+
+    Returns:
+      f32 ``[num_segments, F, num_bins, S]``.
+    """
+    if impl == "pallas":
+        from . import histogram_pallas
+        return histogram_pallas.compute_histograms_pallas(
+            bins, stats, seg_id, num_segments, num_bins)
+
+    n, num_features = bins.shape
+    s = stats.shape[1]
+    k = num_segments * s
+    bins = bins.astype(jnp.int32)
+    # fold segment into stats: segstats[n, seg*S + s]
+    seg_onehot = (seg_id[:, None] == lax.iota(jnp.int32, num_segments)[None, :])
+    segstats = (seg_onehot.astype(stats.dtype)[:, :, None] * stats[:, None, :])
+    segstats = segstats.reshape(n, k)
+
+    if n <= row_chunk:
+        hists = _hist_one_chunk(bins, segstats, num_bins)
+    else:
+        n_chunks = -(-n // row_chunk)
+        pad = n_chunks * row_chunk - n
+        if pad:
+            bins = jnp.pad(bins, ((0, pad), (0, 0)))
+            segstats = jnp.pad(segstats, ((0, pad), (0, 0)))
+        bins_chunks = bins.reshape(n_chunks, row_chunk, num_features)
+        seg_chunks = segstats.reshape(n_chunks, row_chunk, k)
+
+        def chunk_body(acc, xs):
+            b_c, s_c = xs
+            return acc + _hist_one_chunk(b_c, s_c, num_bins), None
+
+        init = jnp.zeros((num_features, num_bins, k), jnp.float32)
+        hists, _ = lax.scan(chunk_body, init, (bins_chunks, seg_chunks))
+
+    # [F, B, K] -> [num_segments, F, B, S]
+    return hists.reshape(num_features, num_bins, num_segments, s).transpose(2, 0, 1, 3)
+
+
+def histogram_psum(hist: jnp.ndarray, axis_name: Optional[str]) -> jnp.ndarray:
+    """Data-parallel histogram merge: the TPU-native equivalent of LightGBM's
+    socket/MPI/NCCL allreduce (upstream ``network/``; SURVEY.md §5
+    "Distributed communication backend").  Inside ``shard_map`` over a row-
+    sharded mesh axis, per-shard partial histograms are summed over ICI/DCN.
+    """
+    if axis_name is None:
+        return hist
+    return lax.psum(hist, axis_name)

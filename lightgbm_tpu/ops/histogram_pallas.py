@@ -1,0 +1,104 @@
+"""Pallas TPU kernel for histogram construction.
+
+Same contract as ``histogram.compute_histograms`` (the GBDT hot loop —
+LightGBM's OpenMP ConstructHistogram, SURVEY.md §2C) but with the one-hot
+matmul staged through VMEM instead of materializing [rows, bins] one-hots in
+HBM:
+
+  grid = (row_chunks,); each program
+    - loads a [CHUNK, F] tile of bin codes and a [CHUNK, K*S] tile of
+      segment-weighted statistics into VMEM,
+    - for each feature, builds the [CHUNK, B] one-hot ON-CHIP and contracts
+      it against the stats tile on the MXU,
+    - accumulates into the full [F, B, K*S] histogram, which stays resident
+      in VMEM across all row chunks (classic reduction-grid pattern).
+
+HBM traffic drops from O(n*B) (materialized one-hot) to O(n*(F + K*S)) —
+the data is read once.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_CHUNK = 2048
+
+
+def _hist_kernel(bins_ref, segstats_ref, out_ref, *, num_features: int,
+                 num_bins: int):
+    """One row-chunk: accumulate every feature's histogram tile."""
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    segstats = segstats_ref[:]                        # [CHUNK, K*S]
+    iota_b = lax.broadcasted_iota(jnp.int32, (bins_ref.shape[0], num_bins), 1)
+    for f in range(num_features):                     # static unroll
+        codes = bins_ref[:, f].reshape(-1, 1)         # [CHUNK, 1]
+        onehot = (codes == iota_b).astype(jnp.float32)
+        # [B, CHUNK] @ [CHUNK, K*S] on the MXU; HIGHEST = true-f32 passes
+        # (bf16-quantized grads visibly corrupt split gains downstream).
+        tile = lax.dot_general(
+            onehot, segstats,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST)
+        out_ref[f, :, :] += tile
+
+
+def compute_histograms_pallas(
+    bins: jnp.ndarray,
+    stats: jnp.ndarray,
+    seg_id: jnp.ndarray,
+    num_segments: int,
+    num_bins: int,
+    chunk: int = DEFAULT_CHUNK,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Drop-in for ``histogram.compute_histograms`` (f32 [K, F, B, S])."""
+    n, num_features = bins.shape
+    s = stats.shape[1]
+    k = num_segments * s
+
+    seg_onehot = (seg_id[:, None] == lax.iota(jnp.int32, num_segments)[None, :])
+    segstats = (seg_onehot.astype(stats.dtype)[:, :, None] * stats[:, None, :])
+    segstats = segstats.reshape(n, k)
+    bins = bins.astype(jnp.int32)
+
+    n_chunks = -(-n // chunk)
+    pad = n_chunks * chunk - n
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        segstats = jnp.pad(segstats, ((0, pad), (0, 0)))
+
+    if interpret is None:
+        # the kernel targets TPU; interpret elsewhere (CPU tests)
+        interpret = jax.default_backend() == "cpu"
+
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, num_features=num_features,
+                          num_bins=num_bins),
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((chunk, num_features), lambda c: (c, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk, k), lambda c: (c, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((num_features, num_bins, k),
+                               lambda c: (0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((num_features, num_bins, k),
+                                       jnp.float32),
+        interpret=interpret,
+    )(bins, segstats)
+
+    return out.reshape(num_features, num_bins, num_segments, s).transpose(
+        2, 0, 1, 3)

@@ -1,0 +1,74 @@
+"""Forest prediction over binned inputs.
+
+TPU-native replacement for LightGBM's per-row per-tree pointer-chasing
+``Predictor`` (SURVEY.md §3.1 bottom frame).  Trees are tensors (struct-of-
+arrays), so traversal is a fixed-trip gather loop: every row steps one level
+per iteration; rows already at a leaf stay put (self-loop), making the loop a
+fixpoint after ``depth`` iterations.  The forest dimension is a ``lax.scan``
+with a round mask, which also gives staged prediction (``ntree_limit``/
+``num_iteration`` truncation — the xgb staged-predict contract of
+bagging_boosting.ipynb:136, SURVEY.md §3.4) with no recompilation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def predict_tree_binned(tree, bins: jnp.ndarray, max_depth_cap: int) -> jnp.ndarray:
+    """Leaf value per row for one tensorized tree.
+
+    Args:
+      tree: Tree namedtuple of arrays (see models.tree.Tree).
+      bins: uint8/int32 [n, F] binned features.
+      max_depth_cap: static traversal depth bound (num_leaves is always safe).
+
+    Returns f32 [n] raw leaf values (no shrinkage applied).
+    """
+    n = bins.shape[0]
+    bins = bins.astype(jnp.int32)
+
+    def step(node, _):
+        feat = tree.split_feature[node]            # [n]
+        thr = tree.split_bin[node]                 # [n]
+        code = jnp.take_along_axis(bins, feat[:, None], axis=1)[:, 0]
+        nxt = jnp.where(code <= thr, tree.left[node], tree.right[node])
+        node = jnp.where(tree.is_leaf[node], node, nxt)
+        return node, None
+
+    node0 = jnp.zeros(n, dtype=jnp.int32)
+    node, _ = lax.scan(step, node0, None, length=max_depth_cap)
+    return tree.leaf_value[node]
+
+
+def predict_forest_binned(
+    forest,
+    bins: jnp.ndarray,
+    learning_rate,
+    init_score,
+    num_iteration: jnp.ndarray,
+    max_depth_cap: int,
+    start_iteration: jnp.ndarray = 0,
+) -> jnp.ndarray:
+    """Sum of trees [start_iteration, start_iteration + num_iteration) —
+    traced truncation, so staged prediction needs no recompilation.
+
+    forest: Tree namedtuple whose arrays have a leading [T] tree axis.
+    """
+    n = bins.shape[0]
+    num_trees = forest.leaf_value.shape[0]
+    start_iteration = jnp.asarray(start_iteration, jnp.int32)
+
+    def body(carry, tree_and_idx):
+        acc = carry
+        tree, t = tree_and_idx
+        val = predict_tree_binned(tree, bins, max_depth_cap)
+        use = ((t >= start_iteration)
+               & (t < start_iteration + num_iteration)).astype(val.dtype)
+        return acc + use * val * learning_rate, None
+
+    acc0 = jnp.full(n, init_score, dtype=jnp.float32)
+    acc, _ = lax.scan(body, acc0, (forest, jnp.arange(num_trees)))
+    return acc

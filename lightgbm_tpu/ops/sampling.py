@@ -1,0 +1,58 @@
+"""Shared stochasticity primitives: bagging and feature sampling.
+
+Single source of truth for the row/column subsampling used by the host-loop
+Booster, the fused cv trainer, and the per-node sampler inside the grower
+(SURVEY.md §2C "Stochasticity") — LightGBM semantics:
+
+  * bagging picks exactly ``floor(fraction * n_valid)`` rows, without
+    replacement, from the currently-valid rows;
+  * feature sampling picks ``max(1, round(fraction * n_avail))`` columns
+    from the available set;
+  * ``fraction >= 1`` is a no-op (mask passthrough).
+
+All inputs are traced, so fractions can vary per vmapped config.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_bag(key, row_mask, fraction, n_valid):
+    """Exact-count row bag within ``row_mask``.
+
+    Args:
+      key: PRNG key.
+      row_mask: f32/bool [n]; rows with mask 0 can never be picked
+        (padding, out-of-fold rows).
+      fraction: traced bagging fraction.
+      n_valid: traced count of maskable rows (float).
+
+    Returns f32 [n] in-bag indicator; passthrough when fraction >= 1.
+    """
+    u = jax.random.uniform(key, row_mask.shape)
+    u = jnp.where(row_mask > 0, u, 2.0)
+    k = jnp.floor(fraction * n_valid).astype(jnp.int32)
+    kth = jnp.sort(u)[jnp.maximum(k - 1, 0)]
+    take = (u <= kth) & (row_mask > 0)
+    keep = jnp.where((k > 0) & (fraction < 1.0), take, row_mask > 0)
+    return keep.astype(jnp.float32)
+
+
+def sample_feature_mask(key, fraction, num_features, base_mask=None):
+    """Column subsample of ``max(1, round(fraction * n_avail))`` features
+    drawn WITHIN ``base_mask`` (so nesting tree-level and node-level
+    sampling can never produce an empty usable set).
+
+    Returns f32 [num_features]; passthrough of base_mask when fraction >= 1.
+    """
+    if base_mask is None:
+        base_mask = jnp.ones(num_features, jnp.float32)
+    avail = jnp.maximum(jnp.sum((base_mask > 0).astype(jnp.float32)), 1.0)
+    k = jnp.clip(jnp.round(fraction * avail), 1, avail)
+    r = jax.random.uniform(key, (num_features,))
+    r = jnp.where(base_mask > 0, r, 2.0)
+    rank = jnp.argsort(jnp.argsort(r))
+    sampled = (rank < k).astype(jnp.float32) * (base_mask > 0)
+    return jnp.where(fraction >= 1.0, base_mask.astype(jnp.float32), sampled)

@@ -1,0 +1,125 @@
+"""Best-split search over histograms.
+
+TPU-native replacement for LightGBM's ``FindBestSplit`` bin scan (upstream
+``treelearner``, exercised via ``num_leaves`` / ``min_data_in_leaf`` in the
+reference grid — r/gridsearchCV.R:96-97; SURVEY.md §2C "Leaf-wise best-first
+split finder").  The scan is fully vectorized: a cumulative sum along the bin
+axis yields every candidate left-partition's (G, H, count) at once, the split
+gain is evaluated for all (feature, bin) pairs in parallel on the VPU, and a
+flat argmax picks the winner.
+
+All regularization thresholds (lambda_l1/l2, min_data_in_leaf,
+min_sum_hessian, min_gain_to_split) are *traced* scalars, so hyper-parameter
+configs can be vmapped without recompilation (SURVEY.md §7 sweep design).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+NEG_INF = -jnp.inf
+
+
+class SplitContext(NamedTuple):
+    """Traced regularization scalars for gain evaluation."""
+
+    lambda_l1: jnp.ndarray
+    lambda_l2: jnp.ndarray
+    min_data_in_leaf: jnp.ndarray
+    min_sum_hessian: jnp.ndarray
+    min_gain_to_split: jnp.ndarray
+
+    @staticmethod
+    def from_params(p) -> "SplitContext":
+        return SplitContext(
+            lambda_l1=jnp.float32(p.lambda_l1),
+            lambda_l2=jnp.float32(p.lambda_l2),
+            min_data_in_leaf=jnp.float32(p.min_data_in_leaf),
+            min_sum_hessian=jnp.float32(p.min_sum_hessian_in_leaf),
+            min_gain_to_split=jnp.float32(p.min_gain_to_split),
+        )
+
+
+def threshold_l1(g: jnp.ndarray, l1: jnp.ndarray) -> jnp.ndarray:
+    """Soft-threshold for L1 regularization (LightGBM ThresholdL1)."""
+    return jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, 0.0)
+
+
+def leaf_objective(sum_g, sum_h, ctx: SplitContext):
+    """-0.5 * optimal loss reduction contribution of a leaf:
+    ThresholdL1(G)^2 / (H + lambda_l2)."""
+    tg = threshold_l1(sum_g, ctx.lambda_l1)
+    return tg * tg / (sum_h + ctx.lambda_l2 + 1e-15)
+
+
+def leaf_output(sum_g, sum_h, ctx: SplitContext):
+    """Optimal leaf value: -ThresholdL1(G) / (H + lambda_l2)."""
+    return -threshold_l1(sum_g, ctx.lambda_l1) / (sum_h + ctx.lambda_l2 + 1e-15)
+
+
+class BestSplit(NamedTuple):
+    gain: jnp.ndarray      # f32 [] best gain (NEG_INF if no valid split)
+    feature: jnp.ndarray   # i32 []
+    bin: jnp.ndarray       # i32 [] split threshold: go left iff code <= bin
+    left_g: jnp.ndarray    # f32 []
+    left_h: jnp.ndarray
+    left_c: jnp.ndarray
+    right_g: jnp.ndarray
+    right_h: jnp.ndarray
+    right_c: jnp.ndarray
+
+
+def find_best_split(
+    hist: jnp.ndarray,
+    ctx: SplitContext,
+    feature_mask: jnp.ndarray,
+    depth_ok: jnp.ndarray,
+) -> BestSplit:
+    """Scan one leaf's histogram for the best (feature, bin) split.
+
+    Args:
+      hist: f32 ``[F, B, 3]`` per-(feature, bin) sums of (grad, hess, count).
+      ctx: regularization scalars.
+      feature_mask: f32/bool ``[F]`` — 1 for usable features this tree
+        (feature_fraction sampling; SURVEY.md §2C "Stochasticity").
+      depth_ok: bool [] — False disqualifies every split (max_depth cap).
+
+    Returns BestSplit with child statistics so the grower can update node
+    state without touching the histogram again.
+    """
+    cum = jnp.cumsum(hist, axis=1)                 # [F, B, 3] inclusive prefix
+    total = cum[:, -1:, :]                         # [F, 1, 3]
+    lg, lh, lc = cum[..., 0], cum[..., 1], cum[..., 2]
+    tg, th, tc = total[..., 0], total[..., 1], total[..., 2]
+    rg, rh, rc = tg - lg, th - lh, tc - lc
+
+    parent_obj = leaf_objective(tg, th, ctx)       # [F, 1] (same for all f)
+    gain = (leaf_objective(lg, lh, ctx) + leaf_objective(rg, rh, ctx)
+            - parent_obj)                          # [F, B]
+
+    valid = (
+        (lc >= ctx.min_data_in_leaf)
+        & (rc >= ctx.min_data_in_leaf)
+        & (lh >= ctx.min_sum_hessian)
+        & (rh >= ctx.min_sum_hessian)
+        & (gain > ctx.min_gain_to_split)
+        & (feature_mask[:, None] > 0)
+        & depth_ok
+    )
+    gain = jnp.where(valid, gain, NEG_INF)
+
+    num_features, num_bins = gain.shape
+    flat_idx = jnp.argmax(gain.reshape(-1))
+    feat = (flat_idx // num_bins).astype(jnp.int32)
+    bin_idx = (flat_idx % num_bins).astype(jnp.int32)
+    best_gain = gain.reshape(-1)[flat_idx]
+
+    return BestSplit(
+        gain=best_gain,
+        feature=feat,
+        bin=bin_idx,
+        left_g=lg[feat, bin_idx], left_h=lh[feat, bin_idx], left_c=lc[feat, bin_idx],
+        right_g=rg[feat, bin_idx], right_h=rh[feat, bin_idx], right_c=rc[feat, bin_idx],
+    )

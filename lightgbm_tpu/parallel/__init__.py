@@ -1,0 +1,7 @@
+"""Distributed execution: mesh construction, sharded training, psum merges.
+
+The TPU-native replacement for LightGBM's ``network/`` socket/MPI/NCCL
+collective backend (SURVEY.md §5 "Distributed communication backend"):
+row-sharded data over a ``jax.sharding.Mesh`` with per-shard histograms
+merged by ``jax.lax.psum`` riding ICI/DCN.
+"""

@@ -1,0 +1,115 @@
+"""Data-parallel GBDT training over a device mesh.
+
+The TPU-native replacement for LightGBM's data-parallel tree learner
+(upstream ``tree_learner=data`` + ``network/`` socket/MPI allreduce, and the
+CUDA/NCCL path its "GPU support" refers to — SURVEY.md §2C, §5):
+
+  * rows are sharded over a 1-D ``Mesh(('data',))`` (ICI within a slice,
+    DCN across slices — same mesh abstraction either way);
+  * each shard builds histograms for its rows only;
+  * ``jax.lax.psum`` over the ``data`` axis merges them (this IS the
+    allreduce — no sockets, no NCCL, no serialization code);
+  * split decisions are computed redundantly-but-identically on every shard
+    from the merged histograms, so the grown tree is replicated by
+    construction and no broadcast step is needed.
+
+Scaling note (SURVEY.md §5 "long-context"): a GBDT has no sequence axis; the
+scale axis is rows (this module) and features/bins (feature-parallel, see
+``feature_parallel.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.gbdt import HyperScalars, _rebuild_objective
+from ..models.tree import Tree, grow_tree
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices=None, axis_name: str = DATA_AXIS) -> Mesh:
+    """1-D row-sharding mesh over the first ``n_devices`` devices.
+
+    Falls back to the virtual CPU backend when the default platform has
+    fewer than ``n_devices`` chips (the multi-chip dry-run path: only one
+    physical TPU is guaranteed locally, SURVEY.md §4).
+    """
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None and len(devices) < n_devices:
+            try:
+                cpus = jax.devices("cpu")
+            except RuntimeError:
+                cpus = []
+            if len(cpus) >= n_devices:
+                devices = cpus
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)}; set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count="
+                f"{n_devices} for a virtual CPU mesh")
+        devices = devices[:n_devices]
+    import numpy as np
+
+    return Mesh(np.array(devices), (axis_name,))
+
+
+def shard_rows(mesh: Mesh, *arrays):
+    """Place row-leading arrays row-sharded on the mesh (rows must divide
+    evenly — Dataset pads to ROW_PAD_MULTIPLE=256 which covers 2/4/8-device
+    meshes)."""
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    out = tuple(jax.device_put(a, sharding) for a in arrays)
+    return out if len(out) > 1 else out[0]
+
+
+@functools.lru_cache(maxsize=None)
+def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
+                       num_bins: int, hist_impl: str = "auto",
+                       row_chunk: int = 131072, is_rf: bool = False):
+    """Build the jitted data-parallel round step for a mesh.
+
+    Returns step(bins, y, w, bag, pred, feature_mask, hyper) ->
+    (tree [replicated], new_pred [row-sharded]).
+
+    The entire per-round body — gradients, bagged stats, the full best-first
+    growth loop with psum-merged histograms, and the train-score update —
+    runs inside ONE ``shard_map``-ed program per round.
+    """
+    obj = _rebuild_objective(obj_key)
+
+    def step(bins, y, w, bag, pred, feature_mask, hyper: HyperScalars, key):
+        g, h = obj.grad_hess(pred, y, w)
+        stats = jnp.stack([g * bag, h * bag, bag], axis=-1)
+        tree, row_leaf = grow_tree(
+            bins, stats, feature_mask, hyper.ctx(), num_leaves, num_bins,
+            hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
+            key=key, axis_name=DATA_AXIS, hist_impl=hist_impl,
+            row_chunk=row_chunk)
+        shrink = jnp.where(is_rf, 1.0, hyper.learning_rate)
+        new_pred = pred + shrink * tree.leaf_value[row_leaf]
+        return tree, new_pred
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS), P(), P(), P()),
+        out_specs=(P(), P(DATA_AXIS)),
+        check_vma=False,  # tree is replicated by construction via psum
+    )
+    return jax.jit(sharded)
+
+
+def dp_full_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
+                       num_bins: int):
+    """One full training step (grad->tree->update) for dry-run validation."""
+    return make_dp_train_step(mesh, obj_key, num_leaves, num_bins)

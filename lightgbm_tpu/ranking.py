@@ -1,0 +1,24 @@
+"""LambdaRank objective + NDCG metric (MSLR-WEB30K north-star config).
+
+Planned for milestone M4 (SURVEY.md §7 build order); importing it before then
+raises with a clear message rather than failing deep inside training.
+"""
+
+from __future__ import annotations
+
+from .objectives import Objective
+
+
+class LambdaRank(Objective):
+    name = "lambdarank"
+    needs_group = True
+
+    def __init__(self, params):
+        raise NotImplementedError(
+            "lambdarank objective is scheduled for milestone M4; "
+            "regression and binary objectives are available now")
+
+
+def get_ranking_metric(name, params=None):
+    raise NotImplementedError(f"{name} metric lands with the lambdarank "
+                              "objective (milestone M4)")

@@ -1,0 +1,1 @@
+"""Utilities: serialization, sweep ledger, RData interop, synthetic data."""

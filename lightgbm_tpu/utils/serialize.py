@@ -1,0 +1,129 @@
+"""Model persistence: Booster <-> JSON text / file.
+
+The reference deliberately avoids model checkpointing ("keep test predictions,
+no model" — LightGBM R.ipynb:845) but LightGBM itself exposes
+``save_model`` / ``model_to_string`` / ``Booster(model_file=...)``; SURVEY.md
+§5 "Checkpoint / resume" mandates building it anyway.  Format: a single JSON
+document (tensorized trees serialize naturally as arrays; bin bounds ride
+along so a loaded model can bin raw inputs without the training data).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import numpy as np
+
+_FORMAT_VERSION = 1
+
+
+def _tree_to_dict(tree) -> dict:
+    return {
+        "split_feature": np.asarray(tree.split_feature).tolist(),
+        "split_bin": np.asarray(tree.split_bin).tolist(),
+        "left": np.asarray(tree.left).tolist(),
+        "right": np.asarray(tree.right).tolist(),
+        "leaf_value": np.asarray(tree.leaf_value, dtype=np.float64).tolist(),
+        "is_leaf": np.asarray(tree.is_leaf).astype(int).tolist(),
+        "count": np.asarray(tree.count, dtype=np.float64).tolist(),
+        "split_gain": np.asarray(tree.split_gain, dtype=np.float64).tolist(),
+        "num_leaves": int(np.asarray(tree.num_leaves)),
+    }
+
+
+def _tree_from_dict(d: dict):
+    import jax.numpy as jnp
+    from ..models.tree import Tree
+
+    return Tree(
+        split_feature=jnp.asarray(d["split_feature"], jnp.int32),
+        split_bin=jnp.asarray(d["split_bin"], jnp.int32),
+        left=jnp.asarray(d["left"], jnp.int32),
+        right=jnp.asarray(d["right"], jnp.int32),
+        leaf_value=jnp.asarray(d["leaf_value"], jnp.float32),
+        is_leaf=jnp.asarray(d["is_leaf"], bool),
+        count=jnp.asarray(d["count"], jnp.float32),
+        split_gain=jnp.asarray(d["split_gain"], jnp.float32),
+        num_leaves=jnp.int32(d["num_leaves"]),
+    )
+
+
+def booster_to_string(booster, num_iteration: Optional[int] = None,
+                      start_iteration: int = 0) -> str:
+    k = num_iteration or len(booster.trees)
+    start = max(int(start_iteration), 0)
+    mapper = booster._bin_mapper_for_predict()
+    import dataclasses
+
+    params_dict = dataclasses.asdict(booster.params)
+    params_dict.pop("extra", None)
+    doc = {
+        "format_version": _FORMAT_VERSION,
+        "framework": "lightgbm_tpu",
+        "params": params_dict,
+        "init_score": float(booster.init_score_),
+        "num_trees": int(min(k, len(booster.trees))),
+        "best_iteration": int(booster.best_iteration),
+        "feature_names": (booster.train_set.feature_names
+                          if booster.train_set is not None
+                          else getattr(booster, "_feature_names", None)),
+        "bin_mapper": {
+            "upper_bounds": [ub.tolist() for ub in mapper.upper_bounds],
+            "nan_bin": mapper.nan_bin.tolist(),
+            "n_bins": mapper.n_bins.tolist(),
+            "is_categorical": mapper.is_categorical.astype(int).tolist(),
+        },
+        "trees": [_tree_to_dict(t) for t in booster.trees[start:start + k]],
+    }
+    doc["num_trees"] = len(doc["trees"])
+    return json.dumps(doc)
+
+
+def save_booster(booster, filename: str,
+                 num_iteration: Optional[int] = None,
+                 start_iteration: int = 0) -> None:
+    with open(filename, "w") as f:
+        f.write(booster_to_string(booster, num_iteration=num_iteration,
+                                  start_iteration=start_iteration))
+
+
+def load_booster_into(booster, model_file: Optional[str] = None,
+                      model_str: Optional[str] = None) -> None:
+    """Populate a bare Booster instance from a saved model."""
+    import jax
+    from ..config import parse_params
+    from ..dataset import BinMapper
+    from ..objectives import create_objective
+
+    if model_str is None:
+        with open(model_file) as f:
+            model_str = f.read()
+    doc = json.loads(model_str)
+    if doc.get("framework") != "lightgbm_tpu":
+        raise ValueError("not a lightgbm_tpu model file")
+
+    params_dict = {k: v for k, v in doc["params"].items() if v is not None}
+    params_dict.pop("metric", None)
+    booster.params = parse_params(params_dict, warn_unknown=False)
+    booster.params.metric = doc["params"].get("metric") or []
+    booster.obj = create_objective(booster.params)
+    booster.train_set = None
+    booster.init_score_ = float(doc["init_score"])
+    booster.trees = [_tree_from_dict(t) for t in doc["trees"]]
+    booster.best_iteration = int(doc.get("best_iteration", -1))
+    booster.best_score = {}
+    booster._valid = []
+    booster._forest_cache = None
+    booster._iter = len(booster.trees)
+    booster._pred_train = None
+    booster._bag = None
+    booster._key = jax.random.PRNGKey(booster.params.seed)
+    booster._feature_names = doc.get("feature_names")
+    bm = doc["bin_mapper"]
+    booster._bin_mapper = BinMapper(
+        [np.asarray(ub, np.float64) for ub in bm["upper_bounds"]],
+        np.asarray(bm["nan_bin"], np.int32),
+        np.asarray(bm["n_bins"], np.int32),
+        np.asarray(bm["is_categorical"], bool),
+    )

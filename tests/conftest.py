@@ -1,0 +1,48 @@
+"""Test env: force an 8-device virtual CPU platform BEFORE jax initializes.
+
+Multi-chip sharding is validated on a virtual host-device mesh because only
+one physical TPU chip is guaranteed (SURVEY.md §4 "test the psum path with
+multi-device simulation").
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon TPU plugin's sitecustomize force-updates jax_platforms to
+# "axon,cpu" at interpreter start, ignoring the env var — override it back
+# before any backend initializes so tests really run on the 8-device CPU.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def small_regression(rng):
+    """Tiny deterministic regression task usable on CPU."""
+    n, f = 2000, 5
+    X = rng.normal(0, 1, (n, f))
+    y = (2.0 * X[:, 0] + np.sin(3 * X[:, 1]) + 0.5 * X[:, 2] * X[:, 3]
+         + 0.1 * rng.normal(0, 1, n))
+    return X, y
+
+
+@pytest.fixture(scope="session")
+def small_binary(rng):
+    n, f = 2000, 5
+    X = rng.normal(0, 1, (n, f))
+    logits = 1.5 * X[:, 0] - X[:, 1] + X[:, 2] * X[:, 3]
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(np.float64)
+    return X, y

@@ -1,0 +1,89 @@
+"""Param schema tests: the reference's param dicts must resolve verbatim."""
+
+import warnings
+
+import pytest
+
+from lightgbm_tpu.config import Params, default_metric_for_objective, parse_params
+
+
+def test_reference_grid_row_params():
+    # a row of the r/gridsearchCV.R:92-100 grid, passed as params
+    p = parse_params({
+        "learning_rate": 0.05,
+        "num_leaves": 63,
+        "min_data_in_leaf": 40,
+        "feature_fraction": 0.8,
+        "bagging_fraction": 0.6,
+        "bagging_freq": 4,
+        "nthread": 4,          # rides through params, maps to ignored knob
+        "objective": "regression",
+    })
+    assert p.learning_rate == 0.05
+    assert p.num_leaves == 63
+    assert p.min_data_in_leaf == 40
+    assert p.feature_fraction == 0.8
+    assert p.bagging_fraction == 0.6
+    assert p.bagging_freq == 4
+    assert p.num_threads == 4
+    assert p.objective == "regression"
+
+
+def test_aliases_resolve():
+    p = parse_params({"eta": 0.02, "max_leaf_nodes": 31, "min_child_samples": 7,
+                      "subsample": 0.9, "colsample_bytree": 0.5,
+                      "reg_alpha": 0.1, "reg_lambda": 0.2,
+                      "n_estimators": 77, "random_state": 11})
+    assert p.learning_rate == 0.02
+    assert p.num_leaves == 31
+    assert p.min_data_in_leaf == 7
+    assert p.bagging_fraction == 0.9
+    assert p.feature_fraction == 0.5
+    assert p.lambda_l1 == pytest.approx(0.1)
+    assert p.lambda_l2 == pytest.approx(0.2)
+    assert p.num_iterations == 77
+    assert p.seed == 11
+
+
+def test_unknown_param_warns_not_raises():
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        p = parse_params({"definitely_not_a_param": 3})
+    assert any("definitely_not_a_param" in str(x.message) for x in w)
+    assert p.extra["definitely_not_a_param"] == 3
+
+
+def test_metric_aliases():
+    p = parse_params({"metric": "rmse"})
+    assert p.metric == ["rmse"]
+    p = parse_params({"eval": "rmse"})  # the R binding arg name
+    assert p.metric == ["rmse"]
+    p = parse_params({"metric": ["l2", "mae"]})
+    assert p.metric == ["l2", "l1"]
+
+
+def test_objective_aliases():
+    assert parse_params({"objective": "mse"}).objective == "regression"
+    assert parse_params({"objective": "reg:linear"}).objective == "regression"
+    assert parse_params({"objective": "binary:logistic"}).objective == "binary"
+
+
+def test_default_metric_is_l2_for_regression():
+    # the sweep relies on default-l2 when eval is omitted (SURVEY §2A row 2g)
+    assert default_metric_for_objective("regression") == "l2"
+    assert default_metric_for_objective("binary") == "binary_logloss"
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError):
+        parse_params({"num_leaves": 1})
+    with pytest.raises(ValueError):
+        parse_params({"bagging_fraction": 0.0})
+    with pytest.raises(ValueError):
+        parse_params({"objective": "not_an_objective"})
+
+
+def test_rf_mode_forces_bagging():
+    p = parse_params({"boosting": "rf"})
+    assert p.bagging_freq >= 1
+    assert 0 < p.bagging_fraction < 1

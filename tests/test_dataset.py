@@ -1,0 +1,106 @@
+"""Binner + Dataset container tests."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.dataset import BinMapper, Dataset, ROW_PAD_MULTIPLE
+
+
+def test_binner_few_distinct_values_get_own_bins():
+    X = np.array([[1.0], [2.0], [2.0], [3.0], [1.0]])
+    bm = BinMapper.fit(X, max_bin=255, min_data_in_bin=1)
+    codes = bm.transform(X)
+    assert codes[:, 0].tolist() == [0, 1, 1, 2, 0]
+    assert bm.n_bins[0] == 3
+
+
+def test_binner_min_data_in_bin_merges_sparse_values():
+    # 3 distinct values with counts 5/1/5: the middle singleton cannot hold
+    # its own bin at min_data_in_bin=3 (LightGBM GreedyFindBin behavior)
+    X = np.array([[1.0]] * 5 + [[2.0]] + [[3.0]] * 5)
+    bm = BinMapper.fit(X, max_bin=255, min_data_in_bin=3)
+    codes = bm.transform(X)
+    assert bm.n_bins[0] == 2
+    assert codes[0, 0] != codes[-1, 0]
+
+
+def test_binner_quantile_mode_monotone():
+    rng = np.random.default_rng(0)
+    X = rng.normal(0, 1, (10000, 1))
+    bm = BinMapper.fit(X, max_bin=16)
+    codes = bm.transform(X)
+    assert codes.max() <= 15
+    # monotone: larger raw value -> bin code >= smaller's
+    order = np.argsort(X[:, 0])
+    assert (np.diff(codes[order, 0].astype(int)) >= 0).all()
+    # roughly equal-frequency bins
+    counts = np.bincount(codes[:, 0], minlength=16)
+    assert counts.min() > 10000 / 16 * 0.5
+
+
+def test_binner_nan_gets_dedicated_bin():
+    X = np.array([[1.0], [np.nan], [2.0], [3.0]])
+    bm = BinMapper.fit(X, max_bin=255, min_data_in_bin=1)
+    codes = bm.transform(X)
+    assert codes[1, 0] == bm.nan_bin[0]
+    assert codes[1, 0] == bm.n_bins[0] - 1
+
+
+def test_binner_reused_for_valid_data():
+    rng = np.random.default_rng(1)
+    X = rng.normal(0, 1, (5000, 3))
+    bm = BinMapper.fit(X, max_bin=64)
+    X2 = rng.normal(0, 1, (100, 3))
+    codes = bm.transform(X2)
+    # out-of-range values clamp to edge bins
+    lo = np.full((1, 3), -100.0)
+    hi = np.full((1, 3), 100.0)
+    assert (bm.transform(lo) == 0).all()
+    assert (bm.transform(hi) == bm.n_bins - 1 - (bm.nan_bin >= 0)).all()
+
+
+def test_dataset_construct_pads_rows():
+    rng = np.random.default_rng(2)
+    X = rng.normal(0, 1, (1000, 4))
+    y = rng.normal(0, 1, 1000)
+    ds = Dataset(X, label=y).construct()
+    assert ds.num_data() == 1000
+    assert ds.X_binned.shape[0] % ROW_PAD_MULTIPLE == 0
+    assert float(ds.row_mask.sum()) == 1000
+    assert float(ds.w[1000:].sum()) == 0.0
+
+
+def test_dataset_reference_shares_bin_mapper():
+    rng = np.random.default_rng(3)
+    X = rng.normal(0, 1, (500, 2))
+    y = rng.normal(0, 1, 500)
+    dtrain = Dataset(X, label=y).construct()
+    dvalid = Dataset(rng.normal(0, 1, (100, 2)), label=rng.normal(0, 1, 100),
+                     reference=dtrain).construct()
+    assert dvalid.bin_mapper is dtrain.bin_mapper
+
+
+def test_dataset_subset():
+    rng = np.random.default_rng(4)
+    X = rng.normal(0, 1, (800, 3))
+    y = rng.normal(0, 1, 800)
+    ds = Dataset(X, label=y).construct()
+    sub = ds.subset(np.arange(100))
+    assert sub.num_data() == 100
+    assert sub.bin_mapper is ds.bin_mapper
+    np.testing.assert_allclose(sub.get_label(), y[:100])
+
+
+def test_dataset_pandas_feature_names():
+    pd = pytest.importorskip("pandas")
+    df = pd.DataFrame({"a": [1.0, 2, 3, 4], "b": [4.0, 3, 2, 1]})
+    ds = Dataset(df, label=[1.0, 2, 3, 4]).construct()
+    assert ds.feature_names == ["a", "b"]
+
+
+def test_categorical_binning():
+    X = np.array([[0.0], [1.0], [2.0], [2.0], [7.0]])
+    bm = BinMapper.fit(X, max_bin=255, categorical=[0])
+    codes = bm.transform(X)
+    assert codes[:, 0].tolist() == [0, 1, 2, 2, 3]
+    assert bm.is_categorical[0]

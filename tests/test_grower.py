@@ -1,0 +1,122 @@
+"""Best-first tree grower: exact fits, leaf budgets, constraints."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.models.tree import grow_tree
+from lightgbm_tpu.ops.predict import predict_tree_binned
+from lightgbm_tpu.ops.split import SplitContext
+
+
+def make_ctx(l1=0.0, l2=0.0, min_data=1.0, min_hess=0.0, min_gain=0.0):
+    return SplitContext(
+        lambda_l1=jnp.float32(l1), lambda_l2=jnp.float32(l2),
+        min_data_in_leaf=jnp.float32(min_data),
+        min_sum_hessian=jnp.float32(min_hess),
+        min_gain_to_split=jnp.float32(min_gain))
+
+
+def grow_simple(bins, residual, num_leaves, num_bins, max_depth=-1,
+                min_data=1.0):
+    """L2 stump fit: grad = pred - y with pred=0 -> grad = -residual, hess=1."""
+    n = bins.shape[0]
+    stats = jnp.stack([jnp.asarray(-residual, jnp.float32),
+                       jnp.ones(n, jnp.float32),
+                       jnp.ones(n, jnp.float32)], axis=-1)
+    fmask = jnp.ones(bins.shape[1], jnp.float32)
+    return grow_tree(jnp.asarray(bins), stats, fmask,
+                     make_ctx(min_data=min_data), num_leaves, num_bins,
+                     max_depth)
+
+
+def test_single_split_recovers_step_function():
+    # y = 1 for bin >= 2, else 0; one split at bin 1 fits exactly
+    bins = np.repeat(np.arange(4, dtype=np.uint8), 25).reshape(-1, 1)
+    y = (bins[:, 0] >= 2).astype(np.float32)
+    tree, row_leaf = grow_simple(bins, y, num_leaves=2, num_bins=4)
+    assert int(tree.num_leaves) == 2
+    pred = np.asarray(tree.leaf_value)[np.asarray(row_leaf)]
+    np.testing.assert_allclose(pred, y, atol=1e-5)
+    assert int(tree.split_feature[0]) == 0
+    assert int(tree.split_bin[0]) == 1
+
+
+def test_full_tree_fits_piecewise_constant():
+    # 4 distinct levels need 4 leaves to fit exactly
+    bins = np.repeat(np.arange(4, dtype=np.uint8), 30).reshape(-1, 1)
+    y = np.array([0.0, 5.0, -2.0, 3.0], np.float32)[bins[:, 0]]
+    tree, row_leaf = grow_simple(bins, y, num_leaves=4, num_bins=4)
+    assert int(tree.num_leaves) == 4
+    pred = np.asarray(tree.leaf_value)[np.asarray(row_leaf)]
+    np.testing.assert_allclose(pred, y, atol=1e-5)
+
+
+def test_leaf_budget_respected():
+    rng = np.random.default_rng(0)
+    bins = rng.integers(0, 16, (500, 3)).astype(np.uint8)
+    y = rng.normal(0, 1, 500).astype(np.float32)
+    tree, _ = grow_simple(bins, y, num_leaves=7, num_bins=16)
+    assert int(tree.num_leaves) <= 7
+    assert int(np.asarray(tree.is_leaf).sum()) == int(tree.num_leaves)
+
+
+def test_best_first_order_takes_biggest_gain_first():
+    # feature 0 separates a huge residual group; feature 1 a small one.
+    # With num_leaves=2 only the big split must be made.
+    n = 400
+    bins = np.zeros((n, 2), np.uint8)
+    bins[:200, 0] = 1
+    bins[::2, 1] = 1
+    y = np.where(np.arange(n) < 200, 10.0, -10.0).astype(np.float32)
+    y += np.where(np.arange(n) % 2 == 0, 0.5, -0.5)
+    tree, _ = grow_simple(bins, y, num_leaves=2, num_bins=2)
+    assert int(tree.split_feature[0]) == 0
+
+
+def test_max_depth_limits_growth():
+    rng = np.random.default_rng(1)
+    bins = rng.integers(0, 32, (1000, 2)).astype(np.uint8)
+    y = rng.normal(0, 1, 1000).astype(np.float32)
+    tree, _ = grow_simple(bins, y, num_leaves=31, num_bins=32)
+    tree_d2, _ = grow_simple(bins, y, num_leaves=31, num_bins=32)
+    n = bins.shape[0]
+    stats = jnp.stack([jnp.asarray(-y), jnp.ones(n), jnp.ones(n)], axis=-1)
+    tree_d2, _ = grow_tree(jnp.asarray(bins), stats, jnp.ones(2),
+                           make_ctx(), 31, 32, max_depth=2)
+    # depth<=2 allows at most 4 leaves
+    assert int(tree_d2.num_leaves) <= 4
+    assert int(tree.num_leaves) > int(tree_d2.num_leaves)
+
+
+def test_min_data_in_leaf_respected():
+    rng = np.random.default_rng(2)
+    bins = rng.integers(0, 8, (300, 2)).astype(np.uint8)
+    y = rng.normal(0, 1, 300).astype(np.float32)
+    tree, row_leaf = grow_simple(bins, y, num_leaves=16, num_bins=8,
+                                 min_data=50.0)
+    leaves = np.asarray(row_leaf)
+    is_leaf = np.asarray(tree.is_leaf)
+    for node in np.unique(leaves):
+        assert is_leaf[node]
+        assert (leaves == node).sum() >= 50
+
+
+def test_traversal_matches_training_assignment():
+    rng = np.random.default_rng(3)
+    bins = rng.integers(0, 16, (600, 4)).astype(np.uint8)
+    y = (bins[:, 0] * 1.0 + (bins[:, 1] > 8) * 5.0).astype(np.float32)
+    tree, row_leaf = grow_simple(bins, y, num_leaves=15, num_bins=16)
+    vals_train = np.asarray(tree.leaf_value)[np.asarray(row_leaf)]
+    vals_traverse = np.asarray(
+        predict_tree_binned(tree, jnp.asarray(bins), max_depth_cap=15))
+    np.testing.assert_allclose(vals_train, vals_traverse, atol=1e-6)
+
+
+def test_pure_leaf_stops_splitting():
+    bins = np.zeros((100, 1), np.uint8)  # single bin: nothing to split
+    y = np.ones(100, np.float32)
+    tree, _ = grow_simple(bins, y, num_leaves=8, num_bins=4)
+    assert int(tree.num_leaves) == 1
+    np.testing.assert_allclose(float(tree.leaf_value[0]), 1.0, atol=1e-5)

@@ -1,0 +1,164 @@
+"""Regression tests for the round-1 code-review findings."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def tiny_reg():
+    rng = np.random.default_rng(3)
+    X = rng.normal(0, 1, (800, 4))
+    y = X[:, 0] + np.sin(2 * X[:, 1]) + 0.05 * rng.normal(0, 1, 800)
+    return X, y
+
+
+def test_custom_fobj_objective_trains(tiny_reg):
+    X, y = tiny_reg
+
+    def my_l2(pred, y_true):
+        return pred - y_true, jnp.ones_like(pred)
+
+    booster = lgb.train({"objective": my_l2, "verbosity": 0},
+                        lgb.Dataset(X, label=y), num_boost_round=10)
+    pred = booster.predict(X)
+    assert np.sqrt(np.mean((pred - y) ** 2)) < np.std(y)
+
+
+def test_max_depth_zero_means_unlimited(tiny_reg):
+    X, y = tiny_reg
+    b0 = lgb.train({"objective": "regression", "max_depth": 0,
+                    "verbosity": 0}, lgb.Dataset(X, label=y),
+                   num_boost_round=3)
+    # must actually split (not constant stumps)
+    assert int(b0.trees[0].num_leaves) > 1
+
+
+def test_max_depth_one_gives_stumps(tiny_reg):
+    X, y = tiny_reg
+    b = lgb.train({"objective": "regression", "max_depth": 1,
+                   "min_data_in_leaf": 1, "verbosity": 0},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+    for t in b.trees:
+        assert int(t.num_leaves) <= 2
+
+
+def test_feature_fraction_bynode_samples_per_split():
+    rng = np.random.default_rng(5)
+    n = 2000
+    X = rng.normal(0, 1, (n, 8))
+    # every feature matters a bit, feature 0 dominates
+    y = 3.0 * X[:, 0] + X[:, 1:].sum(axis=1) * 0.3
+    params = {"objective": "regression", "feature_fraction_bynode": 0.25,
+              "num_leaves": 31, "verbosity": 0, "seed": 1}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    used = set()
+    for t in b.trees:
+        feats = np.asarray(t.split_feature)
+        internal = np.asarray(~t.is_leaf) & (feats >= 0)
+        used.update(feats[internal].tolist())
+    # with per-node sampling, splits cannot all be on the dominant feature
+    assert len(used) > 1
+
+
+def test_num_boost_round_zero_clean(tiny_reg):
+    X, y = tiny_reg
+    dtrain = lgb.Dataset(X, label=y)
+    dvalid = lgb.Dataset(X[:100], label=y[:100], reference=dtrain)
+    booster = lgb.train({"objective": "regression", "verbosity": 0},
+                        dtrain, num_boost_round=0, valid_sets=[dvalid])
+    assert booster.num_trees() == 0
+
+
+def test_subset_clears_stale_group():
+    rng = np.random.default_rng(6)
+    X = rng.normal(0, 1, (100, 2))
+    y = rng.normal(0, 1, 100)
+    ds = lgb.Dataset(X, label=y, group=[50, 50])
+    ds.construct()
+    assert ds.group_id is not None
+    sub = ds.subset(np.arange(10))
+    assert sub.group_id is None
+
+
+def test_categorical_overflow_bin_shared():
+    from lightgbm_tpu.dataset import BinMapper
+
+    # budget forces keeping only the 3 most frequent of 6 categories
+    vals = np.array([0.0] * 50 + [1.0] * 40 + [2.0] * 30 + [3.0] * 2
+                    + [4.0] * 2 + [5.0] * 2).reshape(-1, 1)
+    bm = BinMapper.fit(vals, max_bin=4, categorical=[0])
+    codes = bm.transform(np.array([[0.0], [1.0], [2.0], [3.0], [4.0], [5.0]]))
+    kept = codes[:3, 0]
+    rare = codes[3:, 0]
+    assert len(set(kept.tolist())) == 3
+    # all rare categories share ONE overflow bin, distinct from kept bins
+    assert len(set(rare.tolist())) == 1
+    assert rare[0] not in kept
+
+
+def test_predict_start_iteration(tiny_reg):
+    X, y = tiny_reg
+    b = lgb.train({"objective": "regression", "verbosity": 0},
+                  lgb.Dataset(X, label=y), num_boost_round=10)
+    full = b.predict(X, num_iteration=10, raw_score=True)
+    head = b.predict(X, num_iteration=4, raw_score=True)
+    tail = b.predict(X, start_iteration=4, num_iteration=6, raw_score=True)
+    # init_score appears in both pieces; subtract one copy when recombining
+    np.testing.assert_allclose(head + tail - b.init_score_, full,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_rf_max_features_actually_samples():
+    from lightgbm_tpu.sklearn import LGBMRandomForestRegressor
+
+    rng = np.random.default_rng(9)
+    n = 1500
+    X = rng.normal(0, 1, (n, 6))
+    y = 3.0 * X[:, 0] + 0.2 * X[:, 1:].sum(axis=1)
+    rf = LGBMRandomForestRegressor(n_estimators=8, max_leaf_nodes=8,
+                                   max_features=1, random_state=0,
+                                   min_samples_leaf=5)
+    rf.fit(X, y)
+    used = set()
+    for t in rf.booster_.trees:
+        feats = np.asarray(t.split_feature)
+        internal = np.asarray(~t.is_leaf) & (feats >= 0)
+        used.update(feats[internal].tolist())
+    # mtry=1 of 6: the dominant feature cannot monopolize every split
+    assert len(used) >= 3, used
+
+
+def test_rollback_restores_valid_eval(tiny_reg):
+    X, y = tiny_reg
+    dtrain = lgb.Dataset(X[:600], label=y[:600])
+    dvalid = lgb.Dataset(X[600:], label=y[600:], reference=dtrain)
+    b = lgb.Booster({"objective": "regression", "verbosity": 0,
+                     "metric": "l2"}, dtrain)
+    b.add_valid(dvalid, "va")
+    b.update()
+    before = b.eval_valid()[0][2]
+    b.update()
+    b.rollback_one_iter()
+    after = b.eval_valid()[0][2]
+    assert abs(before - after) < 1e-6
+
+
+def test_pallas_histogram_parity():
+    import jax
+
+    from lightgbm_tpu.ops.histogram import compute_histograms
+    from lightgbm_tpu.ops.histogram_pallas import compute_histograms_pallas
+
+    rng = np.random.default_rng(7)
+    n, F, B, K, S = 3000, 4, 32, 2, 3
+    bins = jnp.asarray(rng.integers(0, B, (n, F)).astype(np.uint8))
+    stats = jnp.asarray(rng.normal(0, 1, (n, S)).astype(np.float32))
+    seg = jnp.asarray(rng.integers(0, K + 1, n).astype(np.int32))
+    ref = compute_histograms(bins, stats, seg, K, B)
+    got = compute_histograms_pallas(bins, stats, seg, K, B, chunk=512)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)

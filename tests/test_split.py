@@ -1,0 +1,106 @@
+"""Split-gain scan: hand-computable cases + constraint handling."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.split import (
+    SplitContext,
+    find_best_split,
+    leaf_objective,
+    leaf_output,
+    threshold_l1,
+)
+
+
+def make_ctx(l1=0.0, l2=0.0, min_data=0.0, min_hess=0.0, min_gain=0.0):
+    return SplitContext(
+        lambda_l1=jnp.float32(l1), lambda_l2=jnp.float32(l2),
+        min_data_in_leaf=jnp.float32(min_data),
+        min_sum_hessian=jnp.float32(min_hess),
+        min_gain_to_split=jnp.float32(min_gain))
+
+
+def test_perfect_split_found():
+    # feature 0: bins 0,1 have grad -1 each (4 rows), bins 2,3 grad +1 (4 rows)
+    # splitting at bin 1 separates negative from positive grads perfectly.
+    B = 4
+    hist = np.zeros((2, B, 3), np.float32)
+    hist[0, 0] = [-2.0, 2.0, 2.0]
+    hist[0, 1] = [-2.0, 2.0, 2.0]
+    hist[0, 2] = [2.0, 2.0, 2.0]
+    hist[0, 3] = [2.0, 2.0, 2.0]
+    # feature 1: uninformative, everything in one bin
+    hist[1, 0] = [0.0, 8.0, 8.0]
+    bs = find_best_split(jnp.asarray(hist), make_ctx(),
+                         jnp.ones(2), jnp.bool_(True))
+    assert int(bs.feature) == 0
+    assert int(bs.bin) == 1
+    # gain = GL^2/HL + GR^2/HR - G^2/H = 16/4 + 16/4 - 0 = 8
+    assert float(bs.gain) == pytest.approx(8.0, rel=1e-5)
+    assert float(bs.left_g) == pytest.approx(-4.0)
+    assert float(bs.right_g) == pytest.approx(4.0)
+    assert float(bs.left_c) == pytest.approx(4.0)
+
+
+def test_min_data_constraint_blocks_small_children():
+    B = 4
+    hist = np.zeros((1, B, 3), np.float32)
+    hist[0, 0] = [-5.0, 1.0, 1.0]   # one row with big grad
+    hist[0, 1] = [0.1, 1.0, 1.0]
+    hist[0, 2] = [0.1, 1.0, 1.0]
+    hist[0, 3] = [4.8, 1.0, 1.0]
+    bs_free = find_best_split(jnp.asarray(hist), make_ctx(),
+                              jnp.ones(1), jnp.bool_(True))
+    assert np.isfinite(float(bs_free.gain))
+    bs_blocked = find_best_split(jnp.asarray(hist), make_ctx(min_data=2),
+                                 jnp.ones(1), jnp.bool_(True))
+    # only the middle split (2 vs 2) remains legal
+    assert int(bs_blocked.bin) == 1
+
+
+def test_feature_mask_disables_feature():
+    B = 2
+    hist = np.zeros((2, B, 3), np.float32)
+    hist[0, 0] = [-3.0, 2.0, 2.0]
+    hist[0, 1] = [3.0, 2.0, 2.0]
+    hist[1, 0] = [-1.0, 2.0, 2.0]
+    hist[1, 1] = [1.0, 2.0, 2.0]
+    mask = jnp.asarray([0.0, 1.0])
+    bs = find_best_split(jnp.asarray(hist), make_ctx(), mask, jnp.bool_(True))
+    assert int(bs.feature) == 1
+
+
+def test_depth_not_ok_blocks_everything():
+    hist = np.zeros((1, 2, 3), np.float32)
+    hist[0, 0] = [-3.0, 2.0, 2.0]
+    hist[0, 1] = [3.0, 2.0, 2.0]
+    bs = find_best_split(jnp.asarray(hist), make_ctx(),
+                         jnp.ones(1), jnp.bool_(False))
+    assert not np.isfinite(float(bs.gain))
+
+
+def test_lambda_l2_shrinks_gain_and_output():
+    g, h = jnp.float32(-6.0), jnp.float32(3.0)
+    ctx0 = make_ctx(l2=0.0)
+    ctx2 = make_ctx(l2=3.0)
+    assert float(leaf_output(g, h, ctx0)) == pytest.approx(2.0)
+    assert float(leaf_output(g, h, ctx2)) == pytest.approx(1.0)
+    assert float(leaf_objective(g, h, ctx0)) > float(leaf_objective(g, h, ctx2))
+
+
+def test_threshold_l1():
+    assert float(threshold_l1(jnp.float32(5.0), jnp.float32(2.0))) == 3.0
+    assert float(threshold_l1(jnp.float32(-5.0), jnp.float32(2.0))) == -3.0
+    assert float(threshold_l1(jnp.float32(1.0), jnp.float32(2.0))) == 0.0
+
+
+def test_last_bin_never_selected():
+    # all mass in last bin -> right side of any split empty except bin<last;
+    # splitting exactly at the last bin would give an empty right child.
+    hist = np.zeros((1, 4, 3), np.float32)
+    hist[0, 3] = [3.0, 2.0, 2.0]
+    bs = find_best_split(jnp.asarray(hist), make_ctx(min_data=1),
+                         jnp.ones(1), jnp.bool_(True))
+    assert not np.isfinite(float(bs.gain))

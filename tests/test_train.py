@@ -1,0 +1,159 @@
+"""End-to-end train()/predict(): quality ladder vs linear + sklearn oracle.
+
+The reference validates by a monotone quality ladder (glmnet 0.146 < GBDT
+0.0957 < tuned ensemble 0.0944 — SURVEY.md §4 item 4); here the same ladder
+runs on synthetic data with sklearn models as independent oracles.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def rmse(y, p):
+    return float(np.sqrt(np.mean((y - p) ** 2)))
+
+
+@pytest.fixture(scope="module")
+def reg_split(rng=None):
+    rng = np.random.default_rng(7)
+    n = 4000
+    X = rng.normal(0, 1, (n, 6))
+    y = (2.0 * X[:, 0] + np.sin(3 * X[:, 1]) + X[:, 2] * (X[:, 3] > 0)
+         + 0.1 * rng.normal(0, 1, n))
+    return (X[:3000], y[:3000], X[3000:], y[3000:])
+
+
+def test_beats_linear_model(reg_split):
+    Xtr, ytr, Xte, yte = reg_split
+    from sklearn.linear_model import LinearRegression
+
+    lin = LinearRegression().fit(Xtr, ytr)
+    lin_rmse = rmse(yte, lin.predict(Xte))
+
+    dtrain = lgb.Dataset(Xtr, label=ytr)
+    booster = lgb.train({"objective": "regression", "learning_rate": 0.1,
+                         "verbosity": 0}, dtrain, num_boost_round=100)
+    gbdt_rmse = rmse(yte, booster.predict(Xte))
+    assert gbdt_rmse < lin_rmse * 0.7, (gbdt_rmse, lin_rmse)
+
+
+def test_close_to_sklearn_hist_gbdt(reg_split):
+    Xtr, ytr, Xte, yte = reg_split
+    from sklearn.ensemble import HistGradientBoostingRegressor
+
+    sk = HistGradientBoostingRegressor(
+        max_iter=100, learning_rate=0.1, max_leaf_nodes=31,
+        min_samples_leaf=20, early_stopping=False).fit(Xtr, ytr)
+    sk_rmse = rmse(yte, sk.predict(Xte))
+
+    dtrain = lgb.Dataset(Xtr, label=ytr)
+    booster = lgb.train({"objective": "regression", "learning_rate": 0.1,
+                         "num_leaves": 31, "min_data_in_leaf": 20,
+                         "verbosity": 0}, dtrain, num_boost_round=100)
+    our_rmse = rmse(yte, booster.predict(Xte))
+    # independent oracle: same config class should land within 15%
+    assert our_rmse < sk_rmse * 1.15, (our_rmse, sk_rmse)
+
+
+def test_training_loss_decreases(reg_split):
+    Xtr, ytr, _, _ = reg_split
+    dtrain = lgb.Dataset(Xtr, label=ytr)
+    booster = lgb.train({"objective": "regression", "verbosity": 0},
+                        dtrain, num_boost_round=50)
+    p10 = booster.predict(Xtr, num_iteration=10)
+    p50 = booster.predict(Xtr, num_iteration=50)
+    assert rmse(ytr, p50) < rmse(ytr, p10)
+
+
+def test_staged_prediction_prefix_consistency(reg_split):
+    # xgboost ntree_limit contract (bagging_boosting.ipynb:136)
+    Xtr, ytr, Xte, _ = reg_split
+    dtrain = lgb.Dataset(Xtr, label=ytr)
+    booster = lgb.train({"objective": "regression", "verbosity": 0},
+                        dtrain, num_boost_round=30)
+    full = booster.predict(Xte, num_iteration=30)
+    alias = booster.predict(Xte, ntree_limit=30)
+    np.testing.assert_allclose(full, alias, rtol=1e-6)
+    p1 = booster.predict(Xte, num_iteration=1)
+    p29 = booster.predict(Xte, num_iteration=29)
+    assert not np.allclose(p1, full)
+    assert np.abs(p29 - full).max() < np.abs(p1 - full).max()
+
+
+def test_early_stopping_with_valid_set(reg_split):
+    Xtr, ytr, Xte, yte = reg_split
+    dtrain = lgb.Dataset(Xtr, label=ytr)
+    dvalid = lgb.Dataset(Xte, label=yte, reference=dtrain)
+    booster = lgb.train(
+        {"objective": "regression", "learning_rate": 0.3, "verbosity": 0,
+         "metric": "rmse"},
+        dtrain, num_boost_round=500, valid_sets=[dvalid],
+        early_stopping_rounds=5)
+    assert 0 < booster.best_iteration <= 500
+    assert "valid_0" in booster.best_score
+    assert "rmse" in booster.best_score["valid_0"]
+
+
+def test_binary_objective_auc(small_binary_module=None):
+    rng = np.random.default_rng(11)
+    n = 3000
+    X = rng.normal(0, 1, (n, 5))
+    logits = 1.5 * X[:, 0] - X[:, 1] + X[:, 2] * X[:, 3]
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(np.float64)
+    dtrain = lgb.Dataset(X[:2400], label=y[:2400])
+    booster = lgb.train({"objective": "binary", "verbosity": 0},
+                        dtrain, num_boost_round=60)
+    p = booster.predict(X[2400:])
+    assert p.min() >= 0 and p.max() <= 1
+    from sklearn.metrics import roc_auc_score
+
+    auc = roc_auc_score(y[2400:], p)
+    assert auc > 0.85, auc
+
+
+def test_bagging_and_feature_fraction_run(reg_split):
+    Xtr, ytr, Xte, yte = reg_split
+    dtrain = lgb.Dataset(Xtr, label=ytr)
+    booster = lgb.train(
+        {"objective": "regression", "bagging_fraction": 0.6,
+         "bagging_freq": 4, "feature_fraction": 0.8, "verbosity": 0},
+        dtrain, num_boost_round=60)
+    assert rmse(yte, booster.predict(Xte)) < rmse(yte, np.full(len(yte), ytr.mean()))
+
+
+def test_deterministic_same_seed(reg_split):
+    Xtr, ytr, Xte, _ = reg_split
+    params = {"objective": "regression", "bagging_fraction": 0.7,
+              "bagging_freq": 2, "feature_fraction": 0.8, "seed": 5,
+              "verbosity": 0}
+    b1 = lgb.train(params, lgb.Dataset(Xtr, label=ytr), num_boost_round=20)
+    b2 = lgb.train(params, lgb.Dataset(Xtr, label=ytr), num_boost_round=20)
+    np.testing.assert_allclose(b1.predict(Xte), b2.predict(Xte), rtol=1e-6)
+
+
+def test_sample_weights_shift_fit():
+    rng = np.random.default_rng(13)
+    n = 2000
+    X = rng.normal(0, 1, (n, 2))
+    y = np.where(X[:, 0] > 0, 1.0, -1.0)
+    w = np.where(X[:, 0] > 0, 10.0, 0.1)
+    dtrain = lgb.Dataset(X, label=y, weight=w)
+    booster = lgb.train({"objective": "regression", "num_leaves": 2,
+                         "verbosity": 0, "min_data_in_leaf": 1},
+                        dtrain, num_boost_round=1)
+    # with extreme weights, init score (weighted mean) leans to +1
+    assert booster.init_score_ > 0.5
+
+
+def test_save_load_roundtrip(tmp_path, reg_split):
+    Xtr, ytr, Xte, _ = reg_split
+    dtrain = lgb.Dataset(Xtr, label=ytr)
+    booster = lgb.train({"objective": "regression", "verbosity": 0},
+                        dtrain, num_boost_round=15)
+    path = str(tmp_path / "model.json")
+    booster.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(booster.predict(Xte), loaded.predict(Xte),
+                               rtol=1e-6)
