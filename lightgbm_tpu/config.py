@@ -247,6 +247,16 @@ _METRIC_ALIASES: Dict[str, str] = {
     "custom": "none",
 }
 
+# TPU-framework-specific knobs (not LightGBM vocabulary): ride in
+# Params.extra without an unknown-parameter warning.
+_FRAMEWORK_KEYS = {
+    "hist_dtype",          # "f32" (default) | "bf16" MXU histogram inputs
+    "hist_impl",           # "auto" | "jnp" | "pallas"
+    "row_chunk",           # histogram row-chunk size
+    "cv_segment_rounds",   # fused-cv rounds per device dispatch
+    "fobj",                # custom objective callable
+}
+
 _BOOSTING_ALIASES: Dict[str, str] = {
     "gbdt": "gbdt",
     "gbrt": "gbdt",
@@ -391,7 +401,7 @@ def parse_params(
     for key, value in merged.items():
         canon = _ALIASES.get(str(key).lower())
         if canon is None:
-            if warn_unknown:
+            if warn_unknown and str(key).lower() not in _FRAMEWORK_KEYS:
                 warnings.warn(f"Unknown parameter '{key}' ignored", stacklevel=2)
             out.extra[str(key)] = value
             continue

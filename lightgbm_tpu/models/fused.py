@@ -73,7 +73,7 @@ from ..ops.sampling import sample_feature_mask as _sample_features_within
 def _fused_cv_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                  metric_name: str, metric_alpha: float, t_max: int,
                  bagging_freq: int, n_configs: int, n_folds: int,
-                 hist_impl: str, row_chunk: int):
+                 hist_impl: str, row_chunk: int, hist_dtype: str = "f32"):
     """Build the jitted fused-cv program for one static configuration."""
     obj = _rebuild_objective(obj_key)
     metric = get_metric(metric_name, Params(alpha=metric_alpha))
@@ -92,7 +92,7 @@ def _fused_cv_fn(obj_key: tuple, num_leaves: int, num_bins: int,
             bins, stats, fmask, hyper.ctx(), num_leaves, num_bins,
             hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
             key=jax.random.fold_in(key, 2), hist_impl=hist_impl,
-            row_chunk=row_chunk)
+            row_chunk=row_chunk, hist_dtype=hist_dtype)
         return pred + hyper.learning_rate * tree.leaf_value[row_leaf]
 
     @jax.jit
@@ -253,7 +253,8 @@ def run_fused_cv_batch(
         _objective_static_key(obj, p0), p0.num_leaves, train_set.num_bins,
         metric_name, float(p0.alpha), num_boost_round, int(bagging_freq),
         n_configs, n_folds, p0.extra.get("hist_impl", "auto"),
-        int(p0.extra.get("row_chunk", 131072)))
+        int(p0.extra.get("row_chunk", 131072)),
+        p0.extra.get("hist_dtype", "f32"))
 
     tm_d = jnp.asarray(tm)
     carry = init_carry(n_pad, jnp.full((n_configs * n_folds,), init,

@@ -106,8 +106,36 @@ def _rebuild_objective(key: tuple) -> Objective:
 
 @functools.lru_cache(maxsize=None)
 def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
-              hist_impl: str, row_chunk: int, is_rf: bool):
+              hist_impl: str, row_chunk: int, is_rf: bool,
+              num_class: int = 1, hist_dtype: str = "f32"):
     obj = _rebuild_objective(obj_key)
+
+    if num_class > 1:
+        # one tree per class per round, grown simultaneously: the class axis
+        # is a vmapped batch over the grower (SURVEY.md §7 batching design)
+        @jax.jit
+        def round_fn_mc(bins, y, w, bag, pred, feature_mask,
+                        hyper: HyperScalars, key):
+            g, h = obj.grad_hess(pred, y, w)          # [n, K]
+
+            def grow_one(gc, hc, kc):
+                stats = jnp.stack([gc * bag, hc * bag, bag], axis=-1)
+                return grow_tree(
+                    bins, stats, feature_mask, hyper.ctx(), num_leaves,
+                    num_bins, hyper.max_depth,
+                    ff_bynode=hyper.feature_fraction_bynode, key=kc,
+                    hist_impl=hist_impl, row_chunk=row_chunk,
+                    hist_dtype=hist_dtype)
+
+            keys = jax.random.split(key, num_class)
+            trees, row_leafs = jax.vmap(grow_one, in_axes=(1, 1, 0))(
+                g, h, keys)                            # leading [K] axis
+            deltas = jax.vmap(lambda t, rl: t.leaf_value[rl])(
+                trees, row_leafs)                      # [K, n]
+            new_pred = pred + hyper.learning_rate * deltas.T
+            return trees, new_pred
+
+        return round_fn_mc
 
     @jax.jit
     def round_fn(bins, y, w, bag, pred, feature_mask, hyper: HyperScalars,
@@ -117,7 +145,8 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
         tree, row_leaf = grow_tree(
             bins, stats, feature_mask, hyper.ctx(), num_leaves, num_bins,
             hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
-            key=key, hist_impl=hist_impl, row_chunk=row_chunk)
+            key=key, hist_impl=hist_impl, row_chunk=row_chunk,
+            hist_dtype=hist_dtype)
         shrink = jnp.where(is_rf, 1.0, hyper.learning_rate)
         new_pred = pred + shrink * tree.leaf_value[row_leaf]
         return tree, new_pred
@@ -126,7 +155,16 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
 
 
 @functools.lru_cache(maxsize=None)
-def _tree_pred_fn(depth_cap: int):
+def _tree_pred_fn(depth_cap: int, num_class: int = 1):
+    if num_class > 1:
+        @jax.jit
+        def add_tree_mc(pred, tree, bins, shrink):   # pred [n, K]
+            vals = jax.vmap(
+                lambda t: predict_tree_binned(t, bins, depth_cap))(tree)
+            return pred + shrink * vals.T
+
+        return add_tree_mc
+
     @jax.jit
     def add_tree(pred, tree, bins, shrink):
         return pred + shrink * predict_tree_binned(tree, bins, depth_cap)
@@ -203,6 +241,12 @@ class Booster:
             self._setup_training()
 
     # ------------------------------------------------------------------
+    @property
+    def _num_class(self) -> int:
+        if self.params.objective in ("multiclass", "multiclassova"):
+            return self.params.num_class
+        return 1
+
     def _setup_training(self) -> None:
         ds = self.train_set
         ds.construct()
@@ -214,14 +258,27 @@ class Booster:
                   else np.ones(ds.num_data_))
         if hasattr(self.obj, "prepare"):
             self.obj.prepare(y_host, w_host)
-        self.init_score_ = float(self.obj.init_score(y_host, w_host))
-        if ds.get_init_score() is not None:
+        k = self._num_class
+        if k > 1:
+            if p.boosting == "rf":
+                raise NotImplementedError("rf boosting with multiclass is "
+                                          "not supported yet")
+            self.init_score_ = np.asarray(
+                self.obj.init_score(y_host, w_host), np.float32)  # [K]
+            if ds.get_init_score() is not None:
+                raise NotImplementedError(
+                    "per-row init_score with multiclass is not supported")
+            self._pred_train = jnp.broadcast_to(
+                jnp.asarray(self.init_score_)[None, :],
+                (int(ds.row_mask.shape[0]), k))
+        elif ds.get_init_score() is not None:
             base = np.concatenate([
                 np.asarray(ds.get_init_score(), np.float32),
                 np.zeros(int(ds.row_mask.shape[0]) - ds.num_data_, np.float32)])
             self._pred_train = jnp.asarray(base)
             self.init_score_ = 0.0
         else:
+            self.init_score_ = float(self.obj.init_score(y_host, w_host))
             self._pred_train = jnp.full(
                 ds.row_mask.shape, self.init_score_, jnp.float32)
         self._bag = ds.row_mask
@@ -258,7 +315,8 @@ class Booster:
         fn = _round_fn(self._obj_key, p.num_leaves, self._num_bins,
                        p.extra.get("hist_impl", "auto"),
                        int(p.extra.get("row_chunk", 131072)),
-                       p.boosting == "rf")
+                       p.boosting == "rf", self._num_class,
+                       p.extra.get("hist_dtype", "f32"))
         round_key = jax.random.fold_in(self._key, i)
         tree, new_pred = fn(ds.X_binned, ds.y, self._w_eff, self._bag,
                             self._pred_train, fmask, self._hyper, round_key)
@@ -268,7 +326,7 @@ class Booster:
         self._forest_cache = None
         # incremental valid-set predictions
         shrink = 1.0 if p.boosting == "rf" else p.learning_rate
-        add_tree = _tree_pred_fn(p.num_leaves)
+        add_tree = _tree_pred_fn(p.num_leaves, self._num_class)
         for idx, (name, vds, vpred) in enumerate(self._valid):
             self._valid[idx] = (
                 name, vds, add_tree(vpred, tree, vds.X_binned,
@@ -344,10 +402,17 @@ class Booster:
         data.construct()
         if data.y is None:
             raise ValueError(f"valid set '{name}' requires a label")
-        vpred = jnp.full(data.row_mask.shape, self.init_score_, jnp.float32)
+        k = self._num_class
+        if k > 1:
+            vpred = jnp.broadcast_to(
+                jnp.asarray(self.init_score_)[None, :],
+                (int(data.row_mask.shape[0]), k))
+        else:
+            vpred = jnp.full(data.row_mask.shape, self.init_score_,
+                             jnp.float32)
         # replay existing trees (valid sets are usually added before round 0)
         shrink = 1.0 if self.params.boosting == "rf" else self.params.learning_rate
-        add_tree = _tree_pred_fn(self.params.num_leaves)
+        add_tree = _tree_pred_fn(self.params.num_leaves, k)
         for tree in self.trees:
             vpred = add_tree(vpred, tree, data.X_binned, jnp.float32(shrink))
         self._valid.append((name, data, vpred))
@@ -399,6 +464,8 @@ class Booster:
         bins = jnp.asarray(codes)
         forest = self._stacked_forest()
         if pred_leaf:
+            if self._num_class > 1:
+                raise NotImplementedError("pred_leaf with multiclass")
             leaves = []
             for t in range(start_iteration, start_iteration + num_iteration):
                 tree = jax.tree.map(lambda a: a[t], forest)
@@ -406,12 +473,25 @@ class Booster:
                 leaves.append(np.asarray(node))
             return np.stack(leaves, axis=1)
         shrink = 1.0 if self.params.boosting == "rf" else self.params.learning_rate
-        raw = predict_forest_binned(
-            forest, bins, jnp.float32(shrink), self.init_score_,
-            jnp.int32(num_iteration), self.params.num_leaves,
-            start_iteration=jnp.int32(start_iteration))
-        if self.params.boosting == "rf" and num_iteration > 0:
-            raw = (raw - self.init_score_) / num_iteration + self.init_score_
+        k = self._num_class
+        if k > 1:
+            cols = []
+            for c in range(k):
+                forest_c = jax.tree.map(lambda a: a[:, c], forest)
+                cols.append(predict_forest_binned(
+                    forest_c, bins, jnp.float32(shrink),
+                    float(self.init_score_[c]), jnp.int32(num_iteration),
+                    self.params.num_leaves,
+                    start_iteration=jnp.int32(start_iteration)))
+            raw = jnp.stack(cols, axis=1)                 # [n, K]
+        else:
+            raw = predict_forest_binned(
+                forest, bins, jnp.float32(shrink), self.init_score_,
+                jnp.int32(num_iteration), self.params.num_leaves,
+                start_iteration=jnp.int32(start_iteration))
+            if self.params.boosting == "rf" and num_iteration > 0:
+                raw = (raw - self.init_score_) / num_iteration \
+                    + self.init_score_
         if raw_score:
             return np.asarray(raw)
         return np.asarray(self.obj.transform(raw))
@@ -456,21 +536,21 @@ class Booster:
         return list(self._feature_names or [])
 
     def num_model_per_iteration(self) -> int:
-        return 1
+        return self._num_class
 
     def feature_importance(self, importance_type: str = "split",
                            iteration: Optional[int] = None) -> np.ndarray:
         k = iteration or len(self.trees)
         out = np.zeros(self.num_feature(), dtype=np.float64)
         for tree in self.trees[:k]:
-            feats = np.asarray(tree.split_feature)
-            gains = np.asarray(tree.split_gain)
-            internal = np.asarray(~tree.is_leaf) & (feats >= 0)
+            feats = np.asarray(tree.split_feature).ravel()
+            gains = np.asarray(tree.split_gain).ravel()
+            internal = np.asarray(~tree.is_leaf).ravel() & (feats >= 0)
             for f, g, used in zip(feats, gains, internal):
                 if used:
                     out[f] += 1.0 if importance_type == "split" else float(g)
         if importance_type == "split":
-            return out.astype(np.int64 if importance_type == "split" else np.float64)
+            return out.astype(np.int64)
         return out
 
     def rollback_one_iter(self) -> "Booster":
@@ -480,7 +560,7 @@ class Booster:
             self._iter -= 1
             is_rf = self.params.boosting == "rf"
             shrink = jnp.float32(1.0 if is_rf else self.params.learning_rate)
-            add = _tree_pred_fn(self.params.num_leaves)
+            add = _tree_pred_fn(self.params.num_leaves, self._num_class)
             if not is_rf:  # rf keeps _pred_train at init score
                 self._pred_train = add(
                     self._pred_train, tree, self.train_set.X_binned, -shrink)

@@ -108,6 +108,7 @@ def grow_tree(
     axis_name: Optional[str] = None,
     hist_impl: str = "auto",
     row_chunk: int = 131072,
+    hist_dtype: str = "f32",
 ) -> Tuple[Tree, jnp.ndarray]:
     """Grow one best-first tree.
 
@@ -153,9 +154,14 @@ def grow_tree(
                                    base_mask=feature_mask)
 
     def hist_fn(seg_id, num_segments):
-        h = compute_histograms(
-            bins, stats, seg_id, num_segments, num_bins,
-            row_chunk=row_chunk, impl=hist_impl)
+        # custom-vmap op: under fold/config/class batching, calls sharing
+        # this binned matrix collapse into ONE wide-matmul pass instead of
+        # per-element skinny matmuls (memory-bound otherwise)
+        from ..ops.histogram import batched_histogram_op
+
+        op = batched_histogram_op(num_segments, num_bins, row_chunk,
+                                  hist_impl, hist_dtype)
+        h = op(bins, stats, seg_id)
         return histogram_psum(h, axis_name)
 
     # ---- root -------------------------------------------------------------

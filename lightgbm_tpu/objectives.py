@@ -200,8 +200,10 @@ def create_objective(params: Params) -> Objective:
             raise ValueError("objective='none' requires a custom fobj")
         return CustomObjective(params, fobj)
     if params.objective in ("multiclass", "multiclassova"):
-        from .multiclass import Multiclass  # deferred: optional heavy path
-        return Multiclass(params)
+        from .multiclass import Multiclass, MulticlassOVA
+        cls = MulticlassOVA if params.objective == "multiclassova" else \
+            Multiclass
+        return cls(params)
     if params.objective == "lambdarank":
         from .ranking import LambdaRank
         return LambdaRank(params)

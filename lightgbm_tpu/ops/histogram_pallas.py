@@ -20,6 +20,7 @@ the data is read once.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -31,45 +32,54 @@ DEFAULT_CHUNK = 2048
 
 
 def _hist_kernel(bins_ref, segstats_ref, out_ref, *, num_features: int,
-                 num_bins: int):
+                 num_bins: int, hist_dtype: str = "f32"):
     """One row-chunk: accumulate every feature's histogram tile."""
 
     @pl.when(pl.program_id(0) == 0)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    segstats = segstats_ref[:]                        # [CHUNK, K*S]
+    compute_t = jnp.bfloat16 if hist_dtype == "bf16" else jnp.float32
+    segstats = segstats_ref[:].astype(compute_t)      # [CHUNK, K*S]
     iota_b = lax.broadcasted_iota(jnp.int32, (bins_ref.shape[0], num_bins), 1)
     for f in range(num_features):                     # static unroll
         codes = bins_ref[:, f].reshape(-1, 1)         # [CHUNK, 1]
-        onehot = (codes == iota_b).astype(jnp.float32)
-        # [B, CHUNK] @ [CHUNK, K*S] on the MXU; HIGHEST = true-f32 passes
-        # (bf16-quantized grads visibly corrupt split gains downstream).
+        onehot = (codes == iota_b).astype(compute_t)
+        # [B, CHUNK] @ [CHUNK, K*S] on the MXU, f32 accumulation either way;
+        # f32 inputs get HIGHEST (true-f32) passes, bf16 runs at native rate
         tile = lax.dot_general(
             onehot, segstats,
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=lax.Precision.HIGHEST)
+            precision=(lax.Precision.DEFAULT if hist_dtype == "bf16"
+                       else lax.Precision.HIGHEST))
         out_ref[f, :, :] += tile
 
 
-def compute_histograms_pallas(
+def hist_from_segstats_pallas(
     bins: jnp.ndarray,
-    stats: jnp.ndarray,
-    seg_id: jnp.ndarray,
-    num_segments: int,
+    segstats: jnp.ndarray,
     num_bins: int,
-    chunk: int = DEFAULT_CHUNK,
+    chunk: Optional[int] = None,
     interpret: bool | None = None,
+    hist_dtype: str = "f32",
 ) -> jnp.ndarray:
-    """Drop-in for ``histogram.compute_histograms`` (f32 [K, F, B, S])."""
-    n, num_features = bins.shape
-    s = stats.shape[1]
-    k = num_segments * s
+    """Kernel core: bins [n,F] x segstats [n,K] -> f32 [F, num_bins, K].
 
-    seg_onehot = (seg_id[:, None] == lax.iota(jnp.int32, num_segments)[None, :])
-    segstats = (seg_onehot.astype(stats.dtype)[:, :, None] * stats[:, None, :])
-    segstats = segstats.reshape(n, k)
+    The [F, B, K] accumulator stays resident in VMEM across row chunks; the
+    chunk size adapts to K so accumulator + tiles fit the ~16 MB budget.
+    """
+    n, num_features = bins.shape
+    k = segstats.shape[1]
+    if chunk is None:
+        # VMEM budget: out F*B*K*4 + segstats chunk*K*4 + onehot chunk*B*4,
+        # with 4x headroom for the HIGHEST-precision matmul decomposition's
+        # temporaries (empirically needed to stay under the 16 MB scope).
+        out_bytes = num_features * num_bins * k * 4
+        budget = 10 * 1024 * 1024 - out_bytes
+        per_row = (k + num_bins + num_features) * 4 * 4
+        chunk = max(256, min(DEFAULT_CHUNK, budget // max(per_row, 1)))
+        chunk = int(chunk) // 256 * 256 or 256
     bins = bins.astype(jnp.int32)
 
     n_chunks = -(-n // chunk)
@@ -82,9 +92,9 @@ def compute_histograms_pallas(
         # the kernel targets TPU; interpret elsewhere (CPU tests)
         interpret = jax.default_backend() == "cpu"
 
-    out = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_hist_kernel, num_features=num_features,
-                          num_bins=num_bins),
+                          num_bins=num_bins, hist_dtype=hist_dtype),
         grid=(n_chunks,),
         in_specs=[
             pl.BlockSpec((chunk, num_features), lambda c: (c, 0),
@@ -100,5 +110,27 @@ def compute_histograms_pallas(
         interpret=interpret,
     )(bins, segstats)
 
+
+def compute_histograms_pallas(
+    bins: jnp.ndarray,
+    stats: jnp.ndarray,
+    seg_id: jnp.ndarray,
+    num_segments: int,
+    num_bins: int,
+    chunk: Optional[int] = DEFAULT_CHUNK,
+    interpret: bool | None = None,
+    hist_dtype: str = "f32",
+) -> jnp.ndarray:
+    """Drop-in for ``histogram.compute_histograms`` (f32 [K, F, B, S])."""
+    n, num_features = bins.shape
+    s = stats.shape[1]
+    k = num_segments * s
+
+    seg_onehot = (seg_id[:, None] == lax.iota(jnp.int32, num_segments)[None, :])
+    segstats = (seg_onehot.astype(stats.dtype)[:, :, None] * stats[:, None, :])
+    segstats = segstats.reshape(n, k)
+    out = hist_from_segstats_pallas(bins, segstats, num_bins, chunk=chunk,
+                                    interpret=interpret,
+                                    hist_dtype=hist_dtype)
     return out.reshape(num_features, num_bins, num_segments, s).transpose(
         2, 0, 1, 3)

@@ -133,11 +133,11 @@ class LGBMModel:
         early_stopping_rounds: Optional[int] = None,
         callbacks: Optional[List[Callable]] = None,
     ) -> "LGBMModel":
+        y_arr = np.asarray(y, dtype=np.float64).reshape(-1)
+        y_fit = self._process_label(y_arr)  # may learn classes_ first
         params = self._resolved_params()
         if eval_metric is not None:
             params["metric"] = eval_metric
-        y_arr = np.asarray(y, dtype=np.float64).reshape(-1)
-        y_fit = self._process_label(y_arr)
         sw = self._class_sample_weight(y_arr, sample_weight)
         dtrain = Dataset(X, label=y_fit, weight=sw, group=group,
                          init_score=init_score, params=params)
@@ -224,11 +224,15 @@ class LGBMClassifier(LGBMModel):
     def _process_label(self, y: np.ndarray) -> np.ndarray:
         self.classes_, y_enc = np.unique(y, return_inverse=True)
         self.n_classes_ = len(self.classes_)
-        if self.n_classes_ > 2:
-            raise NotImplementedError(
-                "multiclass LGBMClassifier lands with the multiclass "
-                "objective (milestone M4)")
         return y_enc.astype(np.float64)
+
+    def _resolved_params(self) -> Dict[str, Any]:
+        p = super()._resolved_params()
+        if getattr(self, "n_classes_", 2) > 2:
+            if self.objective is None:
+                p["objective"] = "multiclass"
+            p["num_class"] = self.n_classes_
+        return p
 
     def _encode_label(self, y: np.ndarray) -> np.ndarray:
         # eval labels must use the TRAINING class mapping (not re-learn it)
@@ -258,7 +262,7 @@ class LGBMClassifier(LGBMModel):
                                    num_iteration=num_iteration, **kwargs)
         if raw_score:
             return proba
-        return self.classes_[(proba[:, 1] > 0.5).astype(int)]
+        return self.classes_[np.argmax(proba, axis=1)]
 
     def predict_proba(self, X, raw_score: bool = False,
                       num_iteration: Optional[int] = None,
@@ -267,6 +271,8 @@ class LGBMClassifier(LGBMModel):
         p = self._Booster.predict(X, raw_score=raw_score,
                                   num_iteration=num_iteration, **kwargs)
         if raw_score:
+            return p
+        if p.ndim == 2:  # multiclass softmax probabilities
             return p
         return np.column_stack([1.0 - p, p])
 

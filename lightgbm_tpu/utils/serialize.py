@@ -28,7 +28,8 @@ def _tree_to_dict(tree) -> dict:
         "is_leaf": np.asarray(tree.is_leaf).astype(int).tolist(),
         "count": np.asarray(tree.count, dtype=np.float64).tolist(),
         "split_gain": np.asarray(tree.split_gain, dtype=np.float64).tolist(),
-        "num_leaves": int(np.asarray(tree.num_leaves)),
+        # scalar for binary/regression; [K] list for multiclass rounds
+        "num_leaves": np.asarray(tree.num_leaves).tolist(),
     }
 
 
@@ -45,7 +46,7 @@ def _tree_from_dict(d: dict):
         is_leaf=jnp.asarray(d["is_leaf"], bool),
         count=jnp.asarray(d["count"], jnp.float32),
         split_gain=jnp.asarray(d["split_gain"], jnp.float32),
-        num_leaves=jnp.int32(d["num_leaves"]),
+        num_leaves=jnp.asarray(d["num_leaves"], jnp.int32),
     )
 
 
@@ -62,7 +63,8 @@ def booster_to_string(booster, num_iteration: Optional[int] = None,
         "format_version": _FORMAT_VERSION,
         "framework": "lightgbm_tpu",
         "params": params_dict,
-        "init_score": float(booster.init_score_),
+        "init_score": np.asarray(booster.init_score_,
+                                 dtype=np.float64).tolist(),
         "num_trees": int(min(k, len(booster.trees))),
         "best_iteration": int(booster.best_iteration),
         "feature_names": (booster.train_set.feature_names
@@ -109,7 +111,9 @@ def load_booster_into(booster, model_file: Optional[str] = None,
     booster.params.metric = doc["params"].get("metric") or []
     booster.obj = create_objective(booster.params)
     booster.train_set = None
-    booster.init_score_ = float(doc["init_score"])
+    init = doc["init_score"]
+    booster.init_score_ = (np.asarray(init, np.float32)
+                           if isinstance(init, list) else float(init))
     booster.trees = [_tree_from_dict(t) for t in doc["trees"]]
     booster.best_iteration = int(doc.get("best_iteration", -1))
     booster.best_score = {}

@@ -175,11 +175,15 @@ def _run_fused(grid, parsed, train_set, ledger, num_boost_round, nfold,
             continue
         # bucket key = everything the fused program treats as compile-time
         # static, INCLUDING objective scalars (a grid axis over e.g.
-        # quantile alpha must not share one objective instance)
+        # quantile alpha must not share one objective instance).
+        # learning_rate also buckets — not for compilation (it is traced)
+        # but because a bucket runs until its SLOWEST config early-stops,
+        # and stopping round is dominated by lr (mixing lr=0.1 with lr=0.01
+        # makes the fast configs idle-run ~5x their needed rounds).
         key = (p.num_leaves, p.bagging_freq if p.bagging_fraction < 1 else 0,
                p.objective, train_set.num_bins, p.alpha, p.sigmoid,
                p.scale_pos_weight, p.is_unbalance, p.fair_c,
-               p.poisson_max_delta_step)
+               p.poisson_max_delta_step, p.learning_rate)
         buckets.setdefault(key, []).append(i)
 
     for key, idxs in sorted(buckets.items()):

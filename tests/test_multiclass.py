@@ -1,0 +1,76 @@
+"""Multiclass softmax objective: K trees per round, sklearn parity."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.sklearn import LGBMClassifier
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    rng = np.random.default_rng(31)
+    n_per = 600
+    centers = np.array([[0, 0], [3, 0.5], [1, 3]])
+    X = np.concatenate([
+        rng.normal(0, 0.9, (n_per, 2)) + c for c in centers])
+    y = np.repeat(np.arange(3), n_per).astype(np.float64)
+    perm = rng.permutation(len(y))
+    return X[perm], y[perm]
+
+
+def test_multiclass_train_predicts_probabilities(blobs):
+    X, y = blobs
+    dtrain = lgb.Dataset(X[:1400], label=y[:1400])
+    booster = lgb.train({"objective": "multiclass", "num_class": 3,
+                         "num_leaves": 15, "verbosity": 0},
+                        dtrain, num_boost_round=30)
+    p = booster.predict(X[1400:])
+    assert p.shape == (len(X) - 1400, 3)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
+    acc = float(np.mean(np.argmax(p, axis=1) == y[1400:]))
+    assert acc > 0.85, acc
+
+
+def test_multiclass_close_to_sklearn_oracle(blobs):
+    X, y = blobs
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    sk = HistGradientBoostingClassifier(
+        max_iter=30, learning_rate=0.1, max_leaf_nodes=15,
+        early_stopping=False).fit(X[:1400], y[:1400])
+    sk_acc = sk.score(X[1400:], y[1400:])
+
+    clf = LGBMClassifier(n_estimators=30, num_leaves=15)
+    clf.fit(X[:1400], y[:1400])
+    assert clf.n_classes_ == 3
+    our_acc = clf.score(X[1400:], y[1400:])
+    assert our_acc > sk_acc - 0.05, (our_acc, sk_acc)
+    proba = clf.predict_proba(X[1400:])
+    assert proba.shape == (len(X) - 1400, 3)
+
+
+def test_multiclass_early_stopping_and_metric(blobs):
+    X, y = blobs
+    dtrain = lgb.Dataset(X[:1200], label=y[:1200])
+    dvalid = lgb.Dataset(X[1200:1500], label=y[1200:1500], reference=dtrain)
+    booster = lgb.train({"objective": "multiclass", "num_class": 3,
+                         "learning_rate": 0.4, "num_leaves": 31,
+                         "verbosity": 0},
+                        dtrain, num_boost_round=200, valid_sets=[dvalid],
+                        early_stopping_rounds=5)
+    assert 0 < booster.best_iteration <= 200
+    assert "multi_logloss" in booster.best_score["valid_0"]
+
+
+def test_multiclass_save_load_roundtrip(tmp_path, blobs):
+    X, y = blobs
+    dtrain = lgb.Dataset(X[:900], label=y[:900])
+    booster = lgb.train({"objective": "multiclass", "num_class": 3,
+                         "num_leaves": 7, "verbosity": 0},
+                        dtrain, num_boost_round=8)
+    path = str(tmp_path / "mc.json")
+    booster.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(booster.predict(X[900:950]),
+                               loaded.predict(X[900:950]), rtol=1e-5)
