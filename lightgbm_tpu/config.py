@@ -101,6 +101,8 @@ _ALIASES: Dict[str, str] = {
     "colsample_bynode": "feature_fraction_bynode",
     "feature_fraction_seed": "feature_fraction_seed",
     "extra_trees": "extra_trees",
+    "grow_policy": "grow_policy",
+    "growth_policy": "grow_policy",
     "early_stopping_round": "early_stopping_round",
     "early_stopping_rounds": "early_stopping_round",
     "early_stopping": "early_stopping_round",
@@ -255,6 +257,7 @@ _FRAMEWORK_KEYS = {
     "row_chunk",           # histogram row-chunk size
     "cv_segment_rounds",   # fused-cv rounds per device dispatch
     "fobj",                # custom objective callable
+    "wave_width",          # frontier grower: max splits per histogram pass
 }
 
 _BOOSTING_ALIASES: Dict[str, str] = {
@@ -293,6 +296,10 @@ class Params:
     feature_fraction_bynode: float = 1.0
     feature_fraction_seed: int = 2
     extra_trees: bool = False
+    # leafwise = strict LightGBM best-first (one split per histogram pass);
+    # frontier = wave growth with histogram subtraction (up to wave_width
+    # splits per pass — the large-data fast path); auto picks by data size.
+    grow_policy: str = "auto"
     early_stopping_round: int = 0
     first_metric_only: bool = False
     max_delta_step: float = 0.0
@@ -446,12 +453,31 @@ def _validate(p: Params) -> None:
         raise ValueError(f"learning_rate must be > 0, got {p.learning_rate}")
     if p.objective in ("multiclass", "multiclassova") and p.num_class < 2:
         raise ValueError("multiclass objective requires num_class >= 2")
+    if p.grow_policy not in ("auto", "leafwise", "frontier"):
+        raise ValueError(
+            f"grow_policy must be auto/leafwise/frontier, got {p.grow_policy}")
     if p.boosting == "rf":
         if p.bagging_freq <= 0 or not (0.0 < p.bagging_fraction < 1.0):
             # LightGBM requires bagging for rf mode; default to sklearn-ish bootstrap
             p.bagging_freq = max(p.bagging_freq, 1)
             if p.bagging_fraction >= 1.0:
                 p.bagging_fraction = 0.632  # P(row in bootstrap sample)
+    if p.boosting == "goss":
+        if p.bagging_fraction < 1.0 or p.bagging_freq > 0:
+            # LightGBM: "Cannot use bagging in GOSS" — GOSS replaces bagging
+            warnings.warn("bagging is disabled under boosting='goss' "
+                          "(GOSS replaces bagging)")
+            p.bagging_fraction = 1.0
+            p.bagging_freq = 0
+        if not (0.0 <= p.top_rate <= 1.0 and 0.0 < p.other_rate <= 1.0):
+            raise ValueError(
+                f"goss requires 0<=top_rate<=1 and 0<other_rate<=1, got "
+                f"top_rate={p.top_rate}, other_rate={p.other_rate}")
+        if p.top_rate + p.other_rate > 1.0:
+            raise ValueError("goss requires top_rate + other_rate <= 1")
+    if p.boosting == "dart":
+        raise NotImplementedError(
+            "boosting='dart' is not implemented; use gbdt, goss or rf")
 
 
 def default_metric_for_objective(objective: str) -> str:

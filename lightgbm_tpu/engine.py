@@ -277,10 +277,25 @@ def cv(
         result.best_score = raw if hib else -raw
         return result
 
+    gs_all = train_set.get_group()
+    qid = (np.repeat(np.arange(len(gs_all)), gs_all)
+           if gs_all is not None else None)
+
+    def _subset_groups(idx):
+        """Group sizes of a whole-query row subset (runs of equal query id —
+        group-aware folds keep queries contiguous)."""
+        q = qid[np.asarray(idx)]
+        edges = np.flatnonzero(np.concatenate([[True], q[1:] != q[:-1],
+                                               [True]]))
+        return np.diff(edges)
+
     cvb = CVBooster()
     for train_idx, test_idx in folds:
         dtr = train_set.subset(train_idx)
         dva = train_set.subset(test_idx)
+        if qid is not None:
+            dtr.set_group(_subset_groups(train_idx))
+            dva.set_group(_subset_groups(test_idx))
         b = Booster(p.copy(), dtr)
         b.add_valid(dva, "valid")
         cvb.append(b)

@@ -230,6 +230,8 @@ def run_fused_cv_batch(
         max_depth=rep([p.max_depth for p in param_list]).astype(jnp.int32),
         feature_fraction_bynode=rep(
             [p.feature_fraction_bynode for p in param_list]),
+        top_rate=rep([p.top_rate for p in param_list]),
+        other_rate=rep([p.other_rate for p in param_list]),
     )
     bag_frac_b = rep([p.bagging_fraction for p in param_list])
     ff_b = rep([p.feature_fraction for p in param_list])

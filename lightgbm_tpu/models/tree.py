@@ -109,6 +109,7 @@ def grow_tree(
     hist_impl: str = "auto",
     row_chunk: int = 131072,
     hist_dtype: str = "f32",
+    wave_width: int = 1,
 ) -> Tuple[Tree, jnp.ndarray]:
     """Grow one best-first tree.
 
@@ -133,7 +134,16 @@ def grow_tree(
     Returns:
       (Tree, row_leaf) — row_leaf gives each training row's final leaf node id
       so the boosting loop can update train predictions with one gather.
+
+    ``wave_width > 1`` dispatches to :func:`grow_tree_frontier` (multiple
+    splits per histogram pass via the subtraction trick — the large-data
+    fast path).
     """
+    if wave_width > 1:
+        return grow_tree_frontier(
+            bins, stats, feature_mask, ctx, num_leaves, num_bins, max_depth,
+            wave_width, ff_bynode=ff_bynode, key=key, axis_name=axis_name,
+            hist_impl=hist_impl, row_chunk=row_chunk, hist_dtype=hist_dtype)
     n, num_features = bins.shape
     capacity = 2 * num_leaves - 1
     max_depth = jnp.asarray(max_depth, jnp.int32)
@@ -281,6 +291,290 @@ def grow_tree(
         return new
 
     st = lax.fori_loop(0, num_leaves - 1, body, st)
+
+    tree = Tree(
+        split_feature=st.split_feature,
+        split_bin=st.split_bin,
+        left=st.left,
+        right=st.right,
+        leaf_value=st.leaf_value,
+        is_leaf=st.is_leaf,
+        count=st.count,
+        split_gain=st.split_gain,
+        num_leaves=st.n_leaves,
+    )
+    return tree, st.row_leaf
+
+
+def _scatter(arr, idx, val, active):
+    """Masked vector scatter: arr[idx[i]] = val[i] where active[i].
+
+    Inactive lanes are redirected to an out-of-bounds index and dropped
+    (positive OOB, because negative indices wrap in JAX).
+    """
+    oob = arr.shape[0]
+    safe = jnp.where(active, idx, oob)
+    return arr.at[safe].set(val, mode="drop")
+
+
+class _WaveState(NamedTuple):
+    # tree under construction (same layout as _GrowState)
+    split_feature: jnp.ndarray
+    split_bin: jnp.ndarray
+    left: jnp.ndarray
+    right: jnp.ndarray
+    leaf_value: jnp.ndarray
+    is_leaf: jnp.ndarray
+    count: jnp.ndarray
+    split_gain: jnp.ndarray
+    depth: jnp.ndarray
+    # cached best candidate split per created node
+    cand_gain: jnp.ndarray
+    cand_feat: jnp.ndarray
+    cand_bin: jnp.ndarray
+    cand_lg: jnp.ndarray
+    cand_lh: jnp.ndarray
+    cand_lc: jnp.ndarray
+    cand_rg: jnp.ndarray
+    cand_rh: jnp.ndarray
+    cand_rc: jnp.ndarray
+    # frontier extras
+    hist_cache: jnp.ndarray     # f32[num_leaves, F, B, 3] per-active-leaf
+    node_slot: jnp.ndarray      # i32[M] node id -> hist_cache slot
+    # dynamic growth state
+    row_leaf: jnp.ndarray
+    n_nodes: jnp.ndarray
+    n_leaves: jnp.ndarray
+
+
+def grow_tree_frontier(
+    bins: jnp.ndarray,
+    stats: jnp.ndarray,
+    feature_mask: jnp.ndarray,
+    ctx: SplitContext,
+    num_leaves: int,
+    num_bins: int,
+    max_depth,
+    wave_width: int,
+    ff_bynode=None,
+    key: Optional[jnp.ndarray] = None,
+    axis_name: Optional[str] = None,
+    hist_impl: str = "auto",
+    row_chunk: int = 131072,
+    hist_dtype: str = "f32",
+) -> Tuple[Tree, jnp.ndarray]:
+    """Best-first growth in WAVES: up to ``wave_width`` splits per data pass.
+
+    The strict grower (:func:`grow_tree`) re-scans all rows once per split —
+    ``num_leaves - 1`` full-data histogram passes per tree, which caps
+    large-``num_leaves`` training at Higgs scale (VERDICT r1 item 3).  This
+    variant is the TPU analogue of LightGBM's histogram-subtraction trick
+    (upstream ``ConstructHistogram`` computes the smaller child and derives
+    the sibling as parent − child; SURVEY.md §3.1 hot-loop trace):
+
+      * per wave, the top-``W`` active leaves by cached candidate gain are
+        split TOGETHER; one histogram pass computes each split's *smaller*
+        child directly (W segments folded into one one-hot matmul — MXU
+        lanes below 128 are padded anyway, so batching W splits into one
+        pass costs roughly the same as one strict trip);
+      * the sibling histogram is ``parent − child`` from a per-leaf
+        histogram cache (f32 ``[num_leaves, F, B, 3]``);
+      * fresh children get their candidate splits scored from the cached
+        histograms with no extra data pass.
+
+    A balanced 127-leaf tree takes ~8 passes instead of 126.  Semantics:
+    with ``wave_width=1`` the split order equals strict best-first; with
+    larger widths the wave's split set is chosen before the wave's children
+    are scored, so when the leaf budget binds mid-wave the tree can spend
+    budget on wave-start leaves that strict growth would have skipped in
+    favor of higher-gain fresh children.  Predictive quality is equivalent
+    in practice (tests compare both modes); LightGBM-exact split order
+    requires the strict grower.
+    """
+    n, num_features = bins.shape
+    capacity = 2 * num_leaves - 1
+    w_width = min(int(wave_width), num_leaves - 1)
+    max_depth = jnp.asarray(max_depth, jnp.int32)
+    neg_inf = jnp.float32(-jnp.inf)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    if ff_bynode is None:
+        ff_bynode = jnp.float32(1.0)
+
+    def node_feature_mask(node_id):
+        from ..ops.sampling import sample_feature_mask
+
+        return sample_feature_mask(jax.random.fold_in(key, node_id),
+                                   ff_bynode, num_features,
+                                   base_mask=feature_mask)
+
+    def hist_fn(seg_id, num_segments):
+        from ..ops.histogram import batched_histogram_op
+
+        op = batched_histogram_op(num_segments, num_bins, row_chunk,
+                                  hist_impl, hist_dtype)
+        h = op(bins, stats, seg_id)
+        return histogram_psum(h, axis_name)
+
+    # ---- root -------------------------------------------------------------
+    root_hist = hist_fn(jnp.zeros(n, jnp.int32), 1)[0]          # [F, B, 3]
+    root_tot = jnp.sum(root_hist[0], axis=0)                     # (g, h, c)
+    root_best = find_best_split(root_hist, ctx, node_feature_mask(0),
+                                jnp.bool_(True))
+
+    def full(val, dtype):
+        return jnp.full((capacity,), val, dtype)
+
+    st = _WaveState(
+        split_feature=full(-1, jnp.int32),
+        split_bin=full(0, jnp.int32),
+        left=full(-1, jnp.int32),
+        right=full(-1, jnp.int32),
+        leaf_value=full(0.0, jnp.float32).at[0].set(
+            leaf_output(root_tot[0], root_tot[1], ctx)),
+        is_leaf=full(False, jnp.bool_).at[0].set(True),
+        count=full(0.0, jnp.float32).at[0].set(root_tot[2]),
+        split_gain=full(0.0, jnp.float32),
+        depth=full(0, jnp.int32),
+        cand_gain=full(neg_inf, jnp.float32).at[0].set(root_best.gain),
+        cand_feat=full(0, jnp.int32).at[0].set(root_best.feature),
+        cand_bin=full(0, jnp.int32).at[0].set(root_best.bin),
+        cand_lg=full(0.0, jnp.float32).at[0].set(root_best.left_g),
+        cand_lh=full(0.0, jnp.float32).at[0].set(root_best.left_h),
+        cand_lc=full(0.0, jnp.float32).at[0].set(root_best.left_c),
+        cand_rg=full(0.0, jnp.float32).at[0].set(root_best.right_g),
+        cand_rh=full(0.0, jnp.float32).at[0].set(root_best.right_h),
+        cand_rc=full(0.0, jnp.float32).at[0].set(root_best.right_c),
+        hist_cache=jnp.zeros((num_leaves, num_features, num_bins, 3),
+                             jnp.float32).at[0].set(root_hist),
+        node_slot=full(0, jnp.int32),
+        row_leaf=jnp.zeros(n, jnp.int32),
+        n_nodes=jnp.int32(1),
+        n_leaves=jnp.int32(1),
+    )
+
+    bins_i32 = bins.astype(jnp.int32)
+    iota_w = lax.iota(jnp.int32, w_width)
+
+    def cond(st: _WaveState):
+        gains = jnp.where(st.is_leaf, st.cand_gain, neg_inf)
+        return (st.n_leaves < num_leaves) & jnp.any(jnp.isfinite(gains))
+
+    def body(st: _WaveState) -> _WaveState:
+        m = capacity
+        # 1. rank active leaves by cached candidate gain (desc, stable).
+        gains = jnp.where(st.is_leaf, st.cand_gain, neg_inf)
+        order = jnp.argsort(-gains)                       # [M]
+        rank = jnp.zeros(m, jnp.int32).at[order].set(
+            lax.iota(jnp.int32, m))
+        budget = num_leaves - st.n_leaves
+        n_cand = jnp.sum(jnp.isfinite(gains)).astype(jnp.int32)
+        # Spend at most HALF the remaining leaf budget per wave: early waves
+        # stay wide (throughput), but near budget exhaustion waves shrink to
+        # 1 so the final splits are allocated (near-)strict-best-first —
+        # this is what keeps wave-grown trees at strict-growth quality when
+        # the budget binds (leaf-wise growth's whole advantage).
+        half = jnp.maximum(jnp.int32(1), budget // 2)
+        s = jnp.minimum(jnp.minimum(n_cand, half),
+                        jnp.int32(w_width))               # splits this wave
+        sel = jnp.isfinite(gains) & (rank < s)            # [M]
+
+        # children node ids, in node space (valid where sel)
+        nl_of = st.n_nodes + 2 * rank
+        nr_of = nl_of + 1
+
+        # 2. partition rows of all splitting leaves at once.
+        p = st.row_leaf
+        psel = sel[p]
+        feat_r = st.cand_feat[p]
+        thr_r = st.cand_bin[p]
+        v = jnp.take_along_axis(bins_i32, feat_r[:, None], axis=1)[:, 0]
+        child = jnp.where(v <= thr_r, nl_of[p], nr_of[p])
+        row_leaf = jnp.where(psel, child, p)
+
+        # 3. one histogram pass over the SMALLER child of every split.
+        parent_r = order[:w_width]                        # [W] node ids
+        active_r = iota_w < s
+        direct_left = st.cand_lc[parent_r] <= st.cand_rc[parent_r]
+        nl_r = st.n_nodes + 2 * iota_w
+        nr_r = nl_r + 1
+        direct_node = jnp.where(direct_left, nl_r, nr_r)
+        seg_of_node = _scatter(full(w_width, jnp.int32), direct_node,
+                               iota_w, active_r)
+        seg_id = seg_of_node[row_leaf]
+        direct_hist = hist_fn(seg_id, w_width)            # [W, F, B, 3]
+
+        # 4. sibling = parent - child (the subtraction trick).
+        parent_slot = st.node_slot[parent_r]              # [W]
+        parent_hist = st.hist_cache[parent_slot]          # [W, F, B, 3]
+        other_hist = parent_hist - direct_hist
+        dl = direct_left[:, None, None, None]
+        left_hist = jnp.where(dl, direct_hist, other_hist)
+        right_hist = jnp.where(dl, other_hist, direct_hist)
+
+        left_slot = parent_slot                           # reuse parent slot
+        right_slot = st.n_leaves + iota_w
+        cache = _scatter(st.hist_cache, left_slot, left_hist, active_r)
+        cache = _scatter(cache, right_slot, right_hist, active_r)
+        node_slot = _scatter(st.node_slot, nl_r, left_slot, active_r)
+        node_slot = _scatter(node_slot, nr_r, right_slot, active_r)
+
+        # 5. score candidates for all 2W fresh children from the cache.
+        child_nodes = jnp.concatenate([nl_r, nr_r])       # [2W]
+        child_hists = jnp.concatenate([left_hist, right_hist])
+        child_depth1 = st.depth[parent_r] + 1             # [W]
+        child_depth = jnp.concatenate([child_depth1, child_depth1])
+        depth_ok = (max_depth <= 0) | (child_depth < max_depth)
+        child_masks = jax.vmap(node_feature_mask)(child_nodes)
+        bs: BestSplit = jax.vmap(
+            find_best_split, in_axes=(0, None, 0, 0))(
+                child_hists, ctx, child_masks, depth_ok)
+        active_2 = jnp.concatenate([active_r, active_r])
+
+        # 6. commit: parents become internal, children become leaves.
+        pf = st.cand_feat[parent_r]
+        pb = st.cand_bin[parent_r]
+        pg = gains[parent_r]
+        lg, lh, lc = (st.cand_lg[parent_r], st.cand_lh[parent_r],
+                      st.cand_lc[parent_r])
+        rg, rh, rc = (st.cand_rg[parent_r], st.cand_rh[parent_r],
+                      st.cand_rc[parent_r])
+        child_vals = jnp.concatenate([leaf_output(lg, lh, ctx),
+                                      leaf_output(rg, rh, ctx)])
+        child_cnts = jnp.concatenate([lc, rc])
+
+        return st._replace(
+            split_feature=_scatter(st.split_feature, parent_r, pf, active_r),
+            split_bin=_scatter(st.split_bin, parent_r, pb, active_r),
+            left=_scatter(st.left, parent_r, nl_r, active_r),
+            right=_scatter(st.right, parent_r, nr_r, active_r),
+            split_gain=_scatter(st.split_gain, parent_r, pg, active_r),
+            is_leaf=_scatter(
+                _scatter(st.is_leaf, parent_r,
+                         jnp.zeros(w_width, jnp.bool_), active_r),
+                child_nodes, jnp.ones(2 * w_width, jnp.bool_), active_2),
+            leaf_value=_scatter(st.leaf_value, child_nodes, child_vals,
+                                active_2),
+            count=_scatter(st.count, child_nodes, child_cnts, active_2),
+            depth=_scatter(st.depth, child_nodes, child_depth, active_2),
+            cand_gain=_scatter(st.cand_gain, child_nodes, bs.gain, active_2),
+            cand_feat=_scatter(st.cand_feat, child_nodes, bs.feature,
+                               active_2),
+            cand_bin=_scatter(st.cand_bin, child_nodes, bs.bin, active_2),
+            cand_lg=_scatter(st.cand_lg, child_nodes, bs.left_g, active_2),
+            cand_lh=_scatter(st.cand_lh, child_nodes, bs.left_h, active_2),
+            cand_lc=_scatter(st.cand_lc, child_nodes, bs.left_c, active_2),
+            cand_rg=_scatter(st.cand_rg, child_nodes, bs.right_g, active_2),
+            cand_rh=_scatter(st.cand_rh, child_nodes, bs.right_h, active_2),
+            cand_rc=_scatter(st.cand_rc, child_nodes, bs.right_c, active_2),
+            hist_cache=cache,
+            node_slot=node_slot,
+            row_leaf=row_leaf,
+            n_nodes=st.n_nodes + 2 * s,
+            n_leaves=st.n_leaves + s,
+        )
+
+    st = lax.while_loop(cond, body, st)
 
     tree = Tree(
         split_feature=st.split_feature,

@@ -40,6 +40,44 @@ def sample_bag(key, row_mask, fraction, n_valid):
     return keep.astype(jnp.float32)
 
 
+def goss_weights(key, g_abs, row_mask, top_rate, other_rate, n_valid):
+    """GOSS row weighting (SURVEY.md §2C "Stochasticity"; LightGBM
+    ``GOSSStrategy::Bagging``): keep the ``top_rate`` fraction of rows with
+    the largest |gradient|, uniformly sample ``other_rate`` of the valid
+    rows from the remainder, and amplify the sampled rows' grad/hess by
+    ``(1 - top_rate) / other_rate`` so small-gradient data keeps its
+    expected contribution.
+
+    Args:
+      key: PRNG key.
+      g_abs: f32 [n] per-row |gradient| (summed over classes if 2-D).
+      row_mask: f32/bool [n] valid-row indicator (0 = padding).
+      top_rate / other_rate: traced fractions (a, b).
+      n_valid: traced float count of valid rows.
+
+    Returns f32 [n] multiplicative weights (0 = dropped); passthrough of
+    ``row_mask`` when a + b >= 1 (LightGBM uses all data then).
+    """
+    valid = row_mask > 0
+    top_k = jnp.floor(top_rate * n_valid).astype(jnp.int32)
+    other_k = jnp.floor(other_rate * n_valid).astype(jnp.int32)
+
+    neg = jnp.where(valid, -g_abs, jnp.inf)
+    rank_g = jnp.argsort(jnp.argsort(neg))
+    is_top = (rank_g < top_k) & valid
+
+    rest = valid & ~is_top
+    u = jax.random.uniform(key, row_mask.shape)
+    u = jnp.where(rest, u, 2.0)
+    rank_u = jnp.argsort(jnp.argsort(u))
+    sampled = (rank_u < other_k) & rest
+
+    amp = (1.0 - top_rate) / jnp.maximum(other_rate, 1e-12)
+    w = is_top.astype(jnp.float32) + sampled.astype(jnp.float32) * amp
+    return jnp.where(top_rate + other_rate >= 1.0,
+                     valid.astype(jnp.float32), w)
+
+
 def sample_feature_mask(key, fraction, num_features, base_mask=None):
     """Column subsample of ``max(1, round(fraction * n_avail))`` features
     drawn WITHIN ``base_mask`` (so nesting tree-level and node-level

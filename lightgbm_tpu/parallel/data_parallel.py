@@ -74,7 +74,8 @@ def shard_rows(mesh: Mesh, *arrays):
 @functools.lru_cache(maxsize=None)
 def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                        num_bins: int, hist_impl: str = "auto",
-                       row_chunk: int = 131072, is_rf: bool = False):
+                       row_chunk: int = 131072, is_rf: bool = False,
+                       wave_width: int = 1):
     """Build the jitted data-parallel round step for a mesh.
 
     Returns step(bins, y, w, bag, pred, feature_mask, hyper) ->
@@ -93,7 +94,7 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
             bins, stats, feature_mask, hyper.ctx(), num_leaves, num_bins,
             hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
             key=key, axis_name=DATA_AXIS, hist_impl=hist_impl,
-            row_chunk=row_chunk)
+            row_chunk=row_chunk, wave_width=wave_width)
         shrink = jnp.where(is_rf, 1.0, hyper.learning_rate)
         new_pred = pred + shrink * tree.leaf_value[row_leaf]
         return tree, new_pred
@@ -110,6 +111,7 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
 
 
 def dp_full_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
-                       num_bins: int):
+                       num_bins: int, wave_width: int = 1):
     """One full training step (grad->tree->update) for dry-run validation."""
-    return make_dp_train_step(mesh, obj_key, num_leaves, num_bins)
+    return make_dp_train_step(mesh, obj_key, num_leaves, num_bins,
+                              wave_width=wave_width)

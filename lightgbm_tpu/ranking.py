@@ -1,24 +1,288 @@
-"""LambdaRank objective + NDCG metric (MSLR-WEB30K north-star config).
+"""LambdaRank objective + NDCG/MAP metrics (MSLR-WEB30K north-star config).
 
-Planned for milestone M4 (SURVEY.md §7 build order); importing it before then
-raises with a clear message rather than failing deep inside training.
+TPU-native replacement for LightGBM's ``src/objective/rank_objective.hpp``
+(LambdarankNDCG) and ``src/metric/rank_metric.hpp``.  Upstream iterates
+queries serially and documents pairwise with early-exit truncation; here the
+whole batch of queries is one dense tensor program:
+
+  * queries are packed host-side into a ``[Q, G]`` index layout (G = padded
+    max docs/query, rounded up to a lane multiple) once per training;
+  * per round, scores gather into ``[Q, G]``, per-query ranks come from one
+    batched sort, and the pairwise lambda matrix ``[qc, G, G]`` is evaluated
+    for a *chunk* of queries at a time inside a ``lax.map`` so peak memory
+    stays bounded while the VPU sees large uniform tiles;
+  * the LightGBM semantics carried over: ΔNDCG pair weighting with inverse
+    max-DCG, sigmoid-scaled pairwise logistic lambdas,
+    ``lambdarank_truncation_level`` (pairs count only when their better-
+    scored member ranks inside the truncation window), and
+    ``lambdarank_norm`` (per-query lambda renormalization);
+  * gradients scatter-add back to the flat row axis — one scatter per round,
+    not per split, so it never touches the histogram hot loop.
+
+Label gains default to LightGBM's ``2^label - 1`` table.
 """
 
 from __future__ import annotations
 
+import functools
+from typing import List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .config import Params
+from .metrics import Metric
 from .objectives import Objective
+
+_LANE = 8  # pad G to a multiple of the sublane for friendlier layouts
+
+
+def _pack_groups(group_sizes: np.ndarray,
+                 max_docs: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side: group sizes -> (doc_idx [Q, G] int32, valid [Q, G] bool).
+
+    Rows are assumed group-contiguous (the lightgbm Dataset contract: group
+    sizes partition the row axis in order — SURVEY.md §2B group field).
+    Padding slots point at row 0 and are masked by ``valid``.
+    """
+    sizes = np.asarray(group_sizes, np.int64)
+    q = len(sizes)
+    g = int(sizes.max()) if max_docs is None else int(max_docs)
+    g = max(_LANE, -(-g // _LANE) * _LANE)
+    starts = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+    doc_idx = np.zeros((q, g), np.int32)
+    valid = np.zeros((q, g), bool)
+    for i, (st, sz) in enumerate(zip(starts, sizes)):
+        doc_idx[i, :sz] = np.arange(st, st + sz, dtype=np.int32)
+        valid[i, :sz] = True
+    return doc_idx, valid
+
+
+def _label_gain_table(label_gain: Optional[List[float]],
+                      max_label: int) -> np.ndarray:
+    if label_gain is not None:
+        t = np.asarray(label_gain, np.float64)
+        if len(t) <= max_label:
+            raise ValueError(
+                f"label_gain has {len(t)} entries but labels reach {max_label}")
+        return t
+    return (2.0 ** np.arange(max_label + 1)) - 1.0  # LightGBM default
+
+
+def _inverse_max_dcg(gains: np.ndarray, valid: np.ndarray,
+                     truncation: int) -> np.ndarray:
+    """Host-side per-query 1/maxDCG@truncation (0 when maxDCG == 0)."""
+    q, g = gains.shape
+    neg = np.where(valid, gains, -np.inf)
+    top = -np.sort(-neg, axis=1)[:, :truncation]           # desc
+    disc = 1.0 / np.log2(2.0 + np.arange(top.shape[1]))
+    dcg = np.sum(np.where(np.isfinite(top), top, 0.0) * disc, axis=1)
+    inv = np.zeros(q)
+    nz = dcg > 0
+    inv[nz] = 1.0 / dcg[nz]
+    return inv
+
+
+def _ranks_desc(scores: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Per-query 0-based rank of each doc under descending score order
+    (the inverse permutation of the per-query argsort)."""
+    masked = jnp.where(valid, scores, -jnp.inf)
+    order = jnp.argsort(-masked, axis=-1, stable=True)
+    iota = jnp.broadcast_to(lax.iota(jnp.int32, order.shape[-1]), order.shape)
+    return jnp.put_along_axis(jnp.zeros_like(order), order, iota, axis=-1,
+                              inplace=False)
 
 
 class LambdaRank(Objective):
+    """Pairwise LambdaRank with ΔNDCG weighting (lambdarank objective)."""
+
     name = "lambdarank"
     needs_group = True
 
-    def __init__(self, params):
-        raise NotImplementedError(
-            "lambdarank objective is scheduled for milestone M4; "
-            "regression and binary objectives are available now")
+    def __init__(self, params: Params):
+        super().__init__(params)
+        self.sigma = float(params.sigmoid)
+        self.truncation = int(params.lambdarank_truncation_level)
+        self.norm = bool(params.lambdarank_norm)
+        self._packed = None
+
+    # -- group setup (called by Booster._setup_training) -----------------
+    def set_group(self, group_sizes: np.ndarray, y_host: np.ndarray,
+                  n_padded: int) -> None:
+        doc_idx, valid = _pack_groups(group_sizes)
+        labels = np.zeros(doc_idx.shape)
+        labels[valid] = y_host[doc_idx[valid]]
+        max_label = int(labels.max()) if labels.size else 0
+        table = _label_gain_table(self.params.label_gain, max_label)
+        gains = np.where(valid, table[labels.astype(np.int64)], 0.0)
+        inv_max = _inverse_max_dcg(gains, valid, self.truncation)
+        self._packed = dict(
+            doc_idx=jnp.asarray(doc_idx),
+            valid=jnp.asarray(valid),
+            gains=jnp.asarray(gains, jnp.float32),
+            inv_max=jnp.asarray(inv_max, jnp.float32),
+            n_padded=n_padded,
+        )
+
+    # -- device pairwise lambdas ----------------------------------------
+    def grad_hess(self, pred, y, w):
+        if self._packed is None:
+            raise ValueError(
+                "lambdarank requires group information: pass group= to the "
+                "training Dataset (lgb.Dataset(X, label=y, group=sizes))")
+        pk = self._packed
+        doc_idx, valid = pk["doc_idx"], pk["valid"]
+        gains, inv_max = pk["gains"], pk["inv_max"]
+        q, g = doc_idx.shape
+        sigma = jnp.float32(self.sigma)
+        trunc = jnp.int32(self.truncation)
+
+        scores = pred[doc_idx]                                   # [Q, G]
+        ranks = _ranks_desc(scores, valid)                       # [Q, G]
+        disc = 1.0 / jnp.log2(2.0 + ranks.astype(jnp.float32))   # [Q, G]
+
+        # chunk queries so the [qc, G, G] pairwise block (and its handful of
+        # elementwise temporaries) stays bounded: ~64 MB of f32 per block
+        qc = max(1, min(q, (16 << 20) // max(g * g, 1)))
+        n_chunks = -(-q // qc)
+        pad_q = n_chunks * qc - q
+
+        def pad0(a):
+            return jnp.pad(a, ((0, pad_q),) + ((0, 0),) * (a.ndim - 1))
+
+        sc = pad0(scores).reshape(n_chunks, qc, g)
+        vc = pad0(valid).reshape(n_chunks, qc, g)
+        gc = pad0(gains).reshape(n_chunks, qc, g)
+        dc = pad0(disc).reshape(n_chunks, qc, g)
+        rc = pad0(ranks).reshape(n_chunks, qc, g)
+        imc = pad0(inv_max).reshape(n_chunks, qc)
+
+        def one_chunk(args):
+            s, v, gn, d, rk, im = args                  # [qc, G] / [qc]
+            s_i = s[:, :, None]
+            s_j = s[:, None, :]
+            better = (gn[:, :, None] > gn[:, None, :]) \
+                & v[:, :, None] & v[:, None, :]
+            # truncation: LightGBM iterates i over the top `truncation`
+            # score-sorted docs — a pair counts iff its better-scored member
+            # is inside the window.
+            in_win = jnp.minimum(rk[:, :, None], rk[:, None, :]) < trunc
+            pair = better & in_win
+            delta = (jnp.abs(gn[:, :, None] - gn[:, None, :])
+                     * jnp.abs(d[:, :, None] - d[:, None, :])
+                     * im[:, None, None])               # ΔNDCG [qc, G, G]
+            p = 1.0 / (1.0 + jnp.exp(sigma * (s_i - s_j)))
+            lam = jnp.where(pair, sigma * p * delta, 0.0)
+            hes = jnp.where(pair, sigma * sigma * p * (1.0 - p) * delta, 0.0)
+            # i is the better doc: push s_i up (negative gradient), s_j down
+            g_row = -jnp.sum(lam, axis=2) + jnp.sum(lam, axis=1)
+            h_row = jnp.sum(hes, axis=2) + jnp.sum(hes, axis=1)
+            if self.norm:
+                all_lam = jnp.sum(lam, axis=(1, 2))
+                norm = jnp.where(
+                    all_lam > 0.0,
+                    jnp.log2(1.0 + all_lam) / jnp.maximum(all_lam, 1e-20),
+                    1.0)
+                g_row = g_row * norm[:, None]
+                h_row = h_row * norm[:, None]
+            return g_row, h_row
+
+        g_q, h_q = lax.map(one_chunk, (sc, vc, gc, dc, rc, imc))
+        g_q = g_q.reshape(-1, g)[:q]
+        h_q = h_q.reshape(-1, g)[:q]
+
+        n_pad = pred.shape[0]
+        safe = jnp.where(valid, doc_idx, n_pad)
+        grad = jnp.zeros(n_pad, jnp.float32).at[safe.reshape(-1)].add(
+            (g_q * valid).reshape(-1), mode="drop")
+        hess = jnp.zeros(n_pad, jnp.float32).at[safe.reshape(-1)].add(
+            (h_q * valid).reshape(-1), mode="drop")
+        hess = jnp.maximum(hess, 2e-3)  # LightGBM min hessian floor for rank
+        return grad * w, hess * w
 
 
-def get_ranking_metric(name, params=None):
-    raise NotImplementedError(f"{name} metric lands with the lambdarank "
-                              "objective (milestone M4)")
+# ---------------------------------------------------------------------------
+# NDCG@k / MAP@k evaluation
+# ---------------------------------------------------------------------------
+
+def ndcg_at_k(scores: jnp.ndarray, gains: jnp.ndarray, valid: jnp.ndarray,
+              k: int) -> jnp.ndarray:
+    """Mean NDCG@k over queries (queries with maxDCG@k == 0 count as 1,
+    matching LightGBM's NDCGMetric convention). [Q, G] dense layout."""
+    masked = jnp.where(valid, scores, -jnp.inf)
+    order = jnp.argsort(-masked, axis=-1, stable=True)
+    top = jnp.take_along_axis(gains, order[:, :k], axis=-1)
+    topv = jnp.take_along_axis(valid, order[:, :k], axis=-1)
+    disc = 1.0 / jnp.log2(2.0 + lax.iota(jnp.float32, min(
+        k, gains.shape[-1])))
+    dcg = jnp.sum(top * topv * disc[None, :], axis=-1)
+    ideal = jnp.take_along_axis(
+        gains, jnp.argsort(-jnp.where(valid, gains, -jnp.inf), axis=-1,
+                           stable=True)[:, :k], axis=-1)
+    idcg = jnp.sum(ideal * disc[None, :], axis=-1)
+    return jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-20), 1.0)
+
+
+@functools.lru_cache(maxsize=None)
+def _ndcg_eval_fn(k: int):
+    @jax.jit
+    def fn(scores, gains, valid, qweight):
+        per_q = ndcg_at_k(scores, gains, valid, k)
+        return jnp.sum(per_q * qweight) / jnp.maximum(jnp.sum(qweight), 1e-12)
+
+    return fn
+
+
+class RankEvalContext:
+    """Per-dataset packed layout for ranking metrics, built once."""
+
+    def __init__(self, group_sizes: np.ndarray, y_host: np.ndarray,
+                 label_gain: Optional[List[float]]):
+        doc_idx, valid = _pack_groups(group_sizes)
+        labels = np.zeros(doc_idx.shape)
+        labels[valid] = y_host[doc_idx[valid]]
+        table = _label_gain_table(label_gain, int(labels.max()))
+        self.doc_idx = jnp.asarray(doc_idx)
+        self.valid = jnp.asarray(valid)
+        self.gains = jnp.asarray(np.where(valid, table[labels.astype(np.int64)],
+                                          0.0), jnp.float32)
+        self.qweight = jnp.ones(doc_idx.shape[0], jnp.float32)
+
+    def ndcg(self, pred_raw: jnp.ndarray, k: int) -> float:
+        scores = pred_raw[self.doc_idx]
+        return float(_ndcg_eval_fn(int(k))(scores, self.gains, self.valid,
+                                           self.qweight))
+
+
+def eval_ranking(pred_raw, ds, eval_at: List[int],
+                 label_gain: Optional[List[float]] = None):
+    """[(name, value, higher_better)] for ndcg@k over a grouped Dataset."""
+    ctx = getattr(ds, "_rank_eval_ctx", None)
+    if ctx is None:
+        gs = ds.get_group()
+        if gs is None:
+            raise ValueError("ndcg metric requires the Dataset to have group")
+        ctx = RankEvalContext(gs, ds.get_label(), label_gain)
+        ds._rank_eval_ctx = ctx
+    return [(f"ndcg@{k}", ctx.ndcg(pred_raw, k), True) for k in eval_at]
+
+
+def get_ranking_metric(name: str, params=None) -> Metric:
+    """Metric registry entry for ndcg — evaluated via the grouped path.
+
+    The plain (pred, y, w) metric signature cannot express grouping, so
+    Booster/_eval_on special-cases ranking metrics through
+    :func:`eval_ranking`; this stub keeps the registry lookup coherent
+    (name + higher_better) for callers that only inspect metadata.
+    """
+    if name not in ("ndcg", "map"):
+        raise ValueError(f"Unknown ranking metric: {name}")
+
+    def _needs_group(*_a, **_k):
+        raise ValueError(
+            f"{name} must be evaluated with group information "
+            "(use Booster.eval_valid / lgb.cv with a grouped Dataset)")
+
+    return Metric(name, True, _needs_group)

@@ -1,0 +1,47 @@
+"""Micro-benchmark: strict vs frontier grower at Higgs-ish scale on TPU.
+
+Usage: python tools/bench_grower.py [n_rows] [rounds]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.datasets import make_higgs_like
+
+
+def run(n, num_leaves, policy, rounds=10, width=None):
+    X, y = make_higgs_like(n)
+    params = {
+        "objective": "binary", "num_leaves": num_leaves,
+        "learning_rate": 0.1, "verbosity": -1, "grow_policy": policy,
+        "min_data_in_leaf": 20,
+    }
+    if width:
+        params["wave_width"] = width
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    b = lgb.Booster(params, ds)
+    b.update()  # compile + run round 0
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        b.update()
+    import jax
+    jax.block_until_ready(b._pred_train)
+    dt = (time.perf_counter() - t0) / rounds
+    return dt
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    for leaves in (31, 127):
+        for policy in ("leafwise", "frontier"):
+            dt = run(n, leaves, policy, rounds)
+            print(f"n={n} leaves={leaves:4d} {policy:9s}: "
+                  f"{dt*1e3:8.1f} ms/round  {n/dt/1e6:7.2f} Mrows/s",
+                  flush=True)
