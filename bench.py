@@ -1,14 +1,21 @@
-"""Benchmark: diamonds-shaped GBDT training throughput on one TPU chip.
+"""Benchmarks: diamonds-shaped training throughput + Higgs-scale binary AUC.
 
-Reference baseline (BASELINE.md): LightGBM trains 200 rounds on the diamonds
-workload (~45.9k rows x 6 features, num_leaves=31) in 1.02 s elapsed on a
-2017 laptop CPU -> ~9.0M row-rounds/s.  This benchmark times the same-shape
-training (synthetic diamonds standing in for the unfetchable ggplot2 data)
-on one TPU chip, excluding the one-time XLA compile (the reference's 1.02s
-also excludes R package load / dataset construction).
+Two workloads, one JSON line:
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+* diamonds (the reference's own headline): LightGBM trains 200 rounds on
+  ~45.9k rows x 6 features, num_leaves=31 in 1.02 s elapsed on a 2017 laptop
+  CPU -> ~9.0M row-rounds/s (BASELINE.md).  We time the same-shape training
+  on one TPU chip.  `vs_baseline` is measured against THIS number.
+* higgs-like (the north star, BASELINE.md:27-30): 1M rows x 28 features,
+  binary objective, num_leaves=127 — rows/sec/chip and holdout AUC against
+  sklearn's HistGradientBoostingClassifier as the network-free CPU-LightGBM
+  oracle (SURVEY.md §4), same rounds / leaves / learning rate.  Reported in
+  the `higgs_*` extras of the same JSON line.
+
+Timing is host-fetch honest: under the remote-TPU tunnel,
+``jax.block_until_ready`` can return before execution finishes, so every
+timed section ends with an ``np.asarray`` value fetch of a result that
+depends on the full computation.
 """
 
 import json
@@ -17,7 +24,7 @@ import time
 import numpy as np
 
 
-def main() -> None:
+def bench_diamonds():
     import lightgbm_tpu as lgb
     from lightgbm_tpu.utils.datasets import (
         make_synthetic_diamonds,
@@ -39,9 +46,7 @@ def main() -> None:
 
     t0 = time.perf_counter()
     booster = lgb.train(params, dtrain, num_boost_round=n_rounds)
-    # force completion of the async dispatch queue
-    import jax
-    jax.block_until_ready(booster._pred_train)
+    _ = np.asarray(booster._pred_train[:4])  # honest completion fetch
     elapsed = time.perf_counter() - t0
 
     # sanity: model quality must beat a linear fit (quality ladder, SURVEY §4)
@@ -55,12 +60,87 @@ def main() -> None:
 
     row_rounds_per_s = len(Xtr) * n_rounds / elapsed
     baseline = 45_900 * 200 / 1.02  # reference: 1.02 s elapsed (BASELINE.md)
-    print(json.dumps({
+    return row_rounds_per_s, baseline, gbdt_rmse
+
+
+def bench_higgs(n=1_000_000, n_rounds=30, num_leaves=127):
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils.datasets import make_higgs_like
+    from sklearn.ensemble import HistGradientBoostingClassifier
+    from sklearn.metrics import roc_auc_score
+
+    X, y = make_higgs_like(n)
+    Xv, yv = make_higgs_like(200_000, seed=9)
+    params = {"objective": "binary", "num_leaves": num_leaves,
+              "learning_rate": 0.1, "verbosity": -1,
+              "min_data_in_leaf": 20}
+
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    b = lgb.Booster(params, ds)
+    b.update_many(n_rounds)          # compile warmup segment
+    _ = np.asarray(b._pred_train[:4])
+    t0 = time.perf_counter()
+    b.update_many(n_rounds)
+    _ = np.asarray(b._pred_train[:4])  # honest completion fetch
+    tpu_s = time.perf_counter() - t0
+    tpu_rows_per_s = n * n_rounds / tpu_s
+    # AUC at the same round budget as the oracle (warmup trained extra trees)
+    auc_tpu = float(roc_auc_score(yv, b.predict(Xv,
+                                                num_iteration=n_rounds)))
+
+    orc = HistGradientBoostingClassifier(
+        max_iter=n_rounds, max_leaf_nodes=num_leaves, learning_rate=0.1,
+        min_samples_leaf=20, max_bins=255, early_stopping=False,
+        validation_fraction=None)
+    t0 = time.perf_counter()
+    orc.fit(X, y)
+    cpu_s = time.perf_counter() - t0
+    cpu_rows_per_s = n * n_rounds / cpu_s
+    auc_cpu = float(roc_auc_score(yv, orc.predict_proba(Xv)[:, 1]))
+
+    return {
+        "higgs_rows": n,
+        "higgs_rounds": n_rounds,
+        "higgs_num_leaves": num_leaves,
+        "higgs_tpu_rows_per_s": round(tpu_rows_per_s, 1),
+        "higgs_cpu_oracle_rows_per_s": round(cpu_rows_per_s, 1),
+        "higgs_vs_oracle": round(tpu_rows_per_s / cpu_rows_per_s, 3),
+        "higgs_auc_tpu": round(auc_tpu, 5),
+        "higgs_auc_cpu_oracle": round(auc_cpu, 5),
+        "higgs_auc_gap": round(auc_cpu - auc_tpu, 5),
+    }
+
+
+def main() -> None:
+    import sys
+
+    if "--profile" in sys.argv:
+        # per-phase breakdown (SURVEY.md §5 tracing row); separate from the
+        # driver's one-JSON-line contract
+        from lightgbm_tpu.utils.datasets import make_higgs_like
+        from lightgbm_tpu.utils.profiling import profile_training
+
+        X, y = make_higgs_like(500_000)
+        rep = profile_training(
+            {"objective": "binary", "num_leaves": 127, "verbosity": -1},
+            X, y, num_boost_round=10)
+        for k, v in rep.items():
+            print(f"  {k:>18}: {v:.6g}" if isinstance(v, float)
+                  else f"  {k:>18}: {v}")
+        return
+
+    row_rounds_per_s, baseline, rmse = bench_diamonds()
+    extras = bench_higgs()
+    out = {
         "metric": "diamonds_train_row_rounds_per_s",
         "value": round(row_rounds_per_s, 1),
         "unit": "row*rounds/s (200 rounds, 45.9k rows, num_leaves=31)",
         "vs_baseline": round(row_rounds_per_s / baseline, 3),
-    }))
+        "diamonds_test_rmse": round(rmse, 5),
+    }
+    out.update(extras)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

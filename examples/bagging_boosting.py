@@ -53,27 +53,61 @@ def main() -> None:
           f"(reference xgb.train: 1.42s)")
 
     # notebook cell 7: staged predictions at tree prefixes {1,20,50,100,300}
+    # — the notebook's deliverable is the matplotlib figure of these staged
+    # fits over the scatter (bagging_boosting.ipynb:134-136)
     print("boosting: staged fit RMSE vs true curve by rounds used")
+    staged = {}
     for k in (1, 20, 50, 100, 300):
         pred = model.predict(grid, ntree_limit=k)
+        staged[k] = pred
         err = float(np.sqrt(np.mean((pred - truth) ** 2)))
         print(f"  first {k:>3} trees: RMSE vs truth {err:.4f}")
 
     # notebook cell 8-9: bagging with 1 / 3 / 100 trees
     # (RandomForestRegressor(n_estimators, max_leaf_nodes=20, max_features=1,
-    #  random_state=345))
+    #  random_state=345)); figures at bagging_boosting.ipynb:195-213
     print("bagging: random-forest fit RMSE vs true curve by forest size")
+    bagged = {}
     for n_trees in (1, 3, 100):
         rf = LGBMRandomForestRegressor(
             n_estimators=n_trees, max_leaf_nodes=20, max_features=1,
             random_state=345, min_samples_leaf=3)
         rf.fit(X, y)
         pred = rf.predict(grid)
+        bagged[n_trees] = pred
         err = float(np.sqrt(np.mean((pred - truth) ** 2)))
         print(f"  {n_trees:>3} trees: RMSE vs truth {err:.4f}")
 
     print("expected shape: boosting error falls with more rounds; "
           "bagging error falls with more trees (variance reduction)")
+    _save_plots(X, y, grid, truth, staged, bagged)
+
+
+def _save_plots(X, y, grid, truth, staged, bagged) -> None:
+    """The notebook's actual output: staged-boosting and forest-size figures
+    (bagging_boosting.ipynb:134-136, 195-213), saved as PNGs (headless)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, axes = plt.subplots(1, 2, figsize=(12, 4.5), sharey=True)
+    for ax, (title, curves) in zip(axes, [
+            ("Boosting: fit after k rounds", staged),
+            ("Bagging: forest of n trees", bagged)]):
+        ax.scatter(X[:, 0], y, s=4, c="lightgray", label="data")
+        ax.plot(grid[:, 0], truth, "k--", lw=1, label="truth")
+        for k, pred in curves.items():
+            ax.plot(grid[:, 0], pred, lw=1.2, label=f"{k}")
+        ax.set_title(title)
+        ax.set_xlabel("x")
+        ax.legend(fontsize=8)
+    axes[0].set_ylabel("y")
+    fig.tight_layout()
+    out = "bagging_boosting.png"
+    fig.savefig(out, dpi=110)
+    plt.close(fig)
+    print(f"wrote {out}")
 
 
 if __name__ == "__main__":

@@ -152,9 +152,14 @@ class BinMapper:
                 codes = np.searchsorted(self.upper_bounds[f], col, side="left")
             if self.nan_bin[f] >= 0:
                 codes = np.where(np.isnan(col), self.nan_bin[f], codes)
-            else:
-                # features with no NaN at fit time: clamp NaN to last real bin
-                codes = np.where(np.isnan(col), self.n_bins[f] - 1, codes)
+            elif not self.is_categorical[f]:
+                # no NaN seen at fit time: LightGBM converts missing to zero
+                # (BinMapper::ValueToBin with missing_type=None — ADVICE r1),
+                # i.e. NaN lands in the bin containing 0.0
+                zero_bin = int(np.searchsorted(self.upper_bounds[f], 0.0,
+                                               side="left"))
+                codes = np.where(np.isnan(col), zero_bin, codes)
+            # (categorical NaN already routed to the overflow bin above)
             out[:, f] = codes.astype(np.uint8)
         return out
 

@@ -53,20 +53,34 @@ class RegressionL2(Objective):
         return (pred - y) * w, w
 
 
+def _weighted_quantile(y: np.ndarray, w: np.ndarray, alpha: float) -> float:
+    """Host-side weighted alpha-quantile (alpha=0.5 -> weighted median):
+    the BoostFromScore base for L1/quantile objectives."""
+    order = np.argsort(y)
+    cw = np.cumsum(w[order])
+    idx = np.searchsorted(cw, alpha * cw[-1])
+    return float(y[order][min(idx, len(y) - 1)])
+
+
 class RegressionL1(Objective):
-    """MAE. Uses the standard constant-hessian surrogate; LightGBM additionally
-    renews leaf values with the weighted-median of residuals (upstream
-    RegressionL1loss::RenewTreeOutput) — a refinement tracked for M4."""
+    """MAE: constant-hessian surrogate gradients + leaf renewal.
+
+    Matching upstream ``RegressionL1loss``: the grower uses sign gradients,
+    then each grown tree's leaf values are refit to the weighted MEDIAN of
+    the leaf's residuals (``RenewTreeOutput``; see
+    models.tree.renew_leaf_values for the TPU formulation)."""
 
     name = "regression_l1"
+
+    @property
+    def renew_alpha(self):
+        """Leaf renewal quantile: weighted median (RenewTreeOutput)."""
+        return 0.5
 
     def init_score(self, y, w):
         if not self.params.boost_from_average:
             return 0.0
-        order = np.argsort(y)
-        cw = np.cumsum(w[order])
-        idx = np.searchsorted(cw, 0.5 * cw[-1])
-        return float(y[order][min(idx, len(y) - 1)])
+        return _weighted_quantile(y, w, 0.5)
 
     def grad_hess(self, pred, y, w):
         return jnp.sign(pred - y) * w, w
@@ -116,6 +130,19 @@ class Poisson(Objective):
 
 class Quantile(Objective):
     name = "quantile"
+
+    @property
+    def renew_alpha(self):
+        """Leaf renewal quantile = alpha (RegressionQuantileloss)."""
+        return float(self.params.alpha)
+
+    def init_score(self, y, w):
+        """Weighted alpha-quantile of the labels (upstream
+        RegressionQuantileloss::BoostFromScore) — starting from 0.0 costs
+        rounds on shifted targets (ADVICE r1)."""
+        if not self.params.boost_from_average:
+            return 0.0
+        return _weighted_quantile(y, w, float(self.params.alpha))
 
     def grad_hess(self, pred, y, w):
         alpha = jnp.float32(self.params.alpha)

@@ -46,10 +46,16 @@ def _hist_one_chunk(bins_c: jnp.ndarray, segstats_c: jnp.ndarray,
         segstats_c = segstats_c.astype(jnp.bfloat16)
 
     def per_feature(_, bins_f):
-        onehot = (bins_f[:, None] == lax.iota(jnp.int32, num_bins)[None, :])
-        onehot = onehot.astype(segstats_c.dtype)
-        h = jnp.einsum(
-            "nb,nk->bk", onehot, segstats_c,
+        # one-hot built ALREADY TRANSPOSED [B, n]: the contraction then runs
+        # over the minor (lane) axis of both operands — a clean
+        # [B, n] @ [n, K] MXU matmul with no relayout of a [n, B] matrix
+        # (the n-major one-hot forces XLA to transpose 33M elements per
+        # chunk-feature, which dominated the pass cost)
+        onehot_t = (bins_f[None, :] == lax.iota(jnp.int32, num_bins)[:, None])
+        onehot_t = onehot_t.astype(segstats_c.dtype)
+        h = lax.dot_general(
+            onehot_t, segstats_c,
+            dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=(lax.Precision.DEFAULT if hist_dtype == "bf16"
                        else lax.Precision.HIGHEST))

@@ -41,15 +41,20 @@ def _hist_kernel(bins_ref, segstats_ref, out_ref, *, num_features: int,
 
     compute_t = jnp.bfloat16 if hist_dtype == "bf16" else jnp.float32
     segstats = segstats_ref[:].astype(compute_t)      # [CHUNK, K*S]
-    iota_b = lax.broadcasted_iota(jnp.int32, (bins_ref.shape[0], num_bins), 1)
+    chunk = bins_ref.shape[0]
+    iota_bt = lax.broadcasted_iota(jnp.int32, (num_bins, chunk), 0)
     for f in range(num_features):                     # static unroll
-        codes = bins_ref[:, f].reshape(-1, 1)         # [CHUNK, 1]
-        onehot = (codes == iota_b).astype(compute_t)
+        codes_t = bins_ref[:, f].reshape(1, chunk)    # [1, CHUNK]
+        # one-hot built ALREADY TRANSPOSED [B, CHUNK] so the dot contracts
+        # over the minor (lane) axis — no in-kernel relayout (the n-major
+        # construction forced a chunk x B transpose per feature, which
+        # dominated the kernel's runtime)
+        onehot_t = (iota_bt == codes_t).astype(compute_t)
         # [B, CHUNK] @ [CHUNK, K*S] on the MXU, f32 accumulation either way;
         # f32 inputs get HIGHEST (true-f32) passes, bf16 runs at native rate
         tile = lax.dot_general(
-            onehot, segstats,
-            dimension_numbers=(((0,), (0,)), ((), ())),
+            onehot_t, segstats,
+            dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=(lax.Precision.DEFAULT if hist_dtype == "bf16"
                        else lax.Precision.HIGHEST))

@@ -14,8 +14,10 @@ CUDA/NCCL path its "GPU support" refers to — SURVEY.md §2C, §5):
     construction and no broadcast step is needed.
 
 Scaling note (SURVEY.md §5 "long-context"): a GBDT has no sequence axis; the
-scale axis is rows (this module) and features/bins (feature-parallel, see
-``feature_parallel.py``).
+scale axis is rows (this module) and features/bins.  Upstream's
+``feature``/``voting`` learners are alternative distribution strategies for
+the same histogram allreduce; on TPU that allreduce is a single ``psum`` over
+ICI, so all ``tree_learner`` values route here (see README).
 """
 
 from __future__ import annotations
@@ -75,7 +77,7 @@ def shard_rows(mesh: Mesh, *arrays):
 def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                        num_bins: int, hist_impl: str = "auto",
                        row_chunk: int = 131072, is_rf: bool = False,
-                       wave_width: int = 1):
+                       wave_width: int = 1, hist_dtype: str = "f32"):
     """Build the jitted data-parallel round step for a mesh.
 
     Returns step(bins, y, w, bag, pred, feature_mask, hyper) ->
@@ -94,7 +96,8 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
             bins, stats, feature_mask, hyper.ctx(), num_leaves, num_bins,
             hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
             key=key, axis_name=DATA_AXIS, hist_impl=hist_impl,
-            row_chunk=row_chunk, wave_width=wave_width)
+            row_chunk=row_chunk, hist_dtype=hist_dtype,
+            wave_width=wave_width)
         shrink = jnp.where(is_rf, 1.0, hyper.learning_rate)
         new_pred = pred + shrink * tree.leaf_value[row_leaf]
         return tree, new_pred

@@ -52,7 +52,8 @@ def _tree_from_dict(d: dict):
 
 def booster_to_string(booster, num_iteration: Optional[int] = None,
                       start_iteration: int = 0) -> str:
-    k = num_iteration or len(booster.trees)
+    k = (len(booster.trees) if num_iteration is None or num_iteration <= 0
+         else num_iteration)
     start = max(int(start_iteration), 0)
     mapper = booster._bin_mapper_for_predict()
     import dataclasses

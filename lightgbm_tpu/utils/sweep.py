@@ -6,9 +6,11 @@ the cartesian parameter grid with ``iteration``/``score`` result columns
 riding along, and ``run_grid_search`` executes ``cv`` per row, checkpointing
 the ledger after **every** config exactly like the reference's
 ``save(paramGrid, file=...)`` "if lgb crashes" pattern (r/gridsearchCV.R:118)
-— but as JSON, idempotently resumable (completed rows are skipped on rerun),
-with the same -1 sentinels paramGrid.RData uses for unfinished rows
-(SURVEY.md §5 "Failure detection").
+— but idempotently resumable (completed rows are skipped on rerun), with the
+same -1 sentinels paramGrid.RData uses for unfinished rows (SURVEY.md §5
+"Failure detection").  Ledger format follows the path suffix: ``.RData``
+reads/writes R's actual serialization (byte-compatible with the reference's
+``save()``/``load()`` checkpoint — utils.rdata), anything else is JSON.
 """
 
 from __future__ import annotations
@@ -50,18 +52,49 @@ class SweepLedger:
         if path and os.path.exists(path):
             self._merge_existing(path)
 
+    @staticmethod
+    def _is_rdata(path: str) -> bool:
+        return path.lower().endswith(".rdata")
+
     def _merge_existing(self, path: str) -> None:
-        with open(path) as f:
-            saved = json.load(f)
-        saved_rows = saved.get("rows", [])
+        if self._is_rdata(path):
+            from .rdata import read_rdata
+            dfs = read_rdata(path)
+            df = dfs.get("paramGrid") or next(iter(dfs.values()), {})
+            cols = list(df.keys())
+            nrow = len(df[cols[0]]) if cols else 0
+            saved_rows = [{c: df[c][i] for c in cols} for i in range(nrow)]
+        else:
+            with open(path) as f:
+                saved = json.load(f)
+            saved_rows = saved.get("rows", [])
         for i, srow in enumerate(saved_rows):
             if i >= len(self.rows):
                 break
             mine = {k: v for k, v in self.rows[i].items()
                     if k not in RESULT_COLUMNS}
             theirs = {k: v for k, v in srow.items() if k not in RESULT_COLUMNS}
-            if mine == theirs and srow.get("iteration", SENTINEL) != SENTINEL:
-                self.rows[i] = dict(srow)
+            if self._cfg_equal(mine, theirs) and \
+                    srow.get("iteration", SENTINEL) != SENTINEL:
+                merged = dict(self.rows[i])
+                merged.update({c: srow[c] for c in RESULT_COLUMNS
+                               if c in srow})
+                self.rows[i] = merged
+
+    @staticmethod
+    def _cfg_equal(a: Dict[str, Any], b: Dict[str, Any]) -> bool:
+        """Config equality across serializations (R numerics come back as
+        floats: num_leaves 31 vs 31.0 must still match)."""
+        if set(a) != set(b):
+            return False
+        for k in a:
+            x, y = a[k], b[k]
+            if isinstance(x, (int, float)) and isinstance(y, (int, float)):
+                if abs(float(x) - float(y)) > 1e-9 * max(1.0, abs(float(x))):
+                    return False
+            elif x != y:
+                return False
+        return True
 
     def done(self, i: int) -> bool:
         return self.rows[i]["iteration"] != SENTINEL
@@ -75,8 +108,15 @@ class SweepLedger:
         if not self.path:
             return
         tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"rows": self.rows, "saved_at": time.time()}, f, indent=1)
+        if self._is_rdata(self.path):
+            from .rdata import write_rdata
+            cols = list(self.rows[0].keys()) if self.rows else []
+            write_rdata(tmp, "paramGrid",
+                        {c: [r[c] for r in self.rows] for c in cols})
+        else:
+            with open(tmp, "w") as f:
+                json.dump({"rows": self.rows, "saved_at": time.time()}, f,
+                          indent=1)
         os.replace(tmp, self.path)
 
     def leaderboard(self) -> List[Dict[str, Any]]:

@@ -1,0 +1,80 @@
+"""RData (RDX2) ledger compatibility (SURVEY.md §7 paramGrid.RData compat)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.utils.rdata import read_rdata, write_rdata
+from lightgbm_tpu.utils.sweep import SweepLedger, expand_grid
+
+REF = "/root/reference/paramGrid.RData"
+
+
+@pytest.mark.skipif(not os.path.exists(REF), reason="reference not mounted")
+def test_read_reference_artifact():
+    """Parse the reference's actual sweep checkpoint: 108x9 data.frame,
+    80 completed rows, 28 crashed lr=0.01 sentinels (SURVEY.md §2A row 5)."""
+    d = read_rdata(REF)
+    pg = d["paramGrid"]
+    assert list(pg.keys()) == [
+        "iteration", "score", "learning_rate", "num_leaves",
+        "min_data_in_leaf", "feature_fraction", "bagging_fraction",
+        "bagging_freq", "nthread"]
+    sc = np.asarray(pg["score"], dtype=float)
+    assert len(sc) == 108
+    done = sc != -1
+    assert done.sum() == 80
+    assert np.all(np.asarray(pg["learning_rate"], float)[~done] == 0.01)
+    assert abs(sc[done].max() - -0.0092703) < 1e-6
+
+
+def test_write_read_roundtrip(tmp_path):
+    cols = {"iteration": [269, -1], "score": [-0.0095, -1.0],
+            "name": ["a", None], "flag": [True, False]}
+    p = str(tmp_path / "t.RData")
+    write_rdata(p, "paramGrid", cols)
+    out = read_rdata(p)["paramGrid"]
+    assert out["iteration"] == [269, -1]
+    assert out["score"] == [-0.0095, -1.0]
+    assert out["name"] == ["a", None]
+    assert out["flag"] == [1, 0]  # R logicals read back as ints
+
+
+def test_ledger_rdata_checkpoint_resume(tmp_path):
+    """SweepLedger with an .RData path writes R-loadable checkpoints and
+    resumes from them (the r/gridsearchCV.R:118,121 save/load pattern)."""
+    grid = expand_grid(learning_rate=[0.1, 0.01], num_leaves=[31, 63],
+                       nthread=[4])
+    path = str(tmp_path / "paramGrid.RData")
+    led = SweepLedger(grid, path)
+    led.record(0, 100, -0.5)
+    led.record(2, 200, -0.25)
+
+    led2 = SweepLedger(grid, path)
+    assert led2.done(0) and led2.done(2)
+    assert not led2.done(1) and not led2.done(3)
+    assert led2.rows[2]["iteration"] == 200
+    assert led2.rows[2]["score"] == -0.25
+
+
+@pytest.mark.skipif(not os.path.exists(REF), reason="reference not mounted")
+def test_ledger_resumes_from_reference_checkpoint():
+    """The TPU sweep can resume the reference's OWN crashed checkpoint:
+    the 80 completed rows are skipped, the 28 lr=0.01 sentinels rerun."""
+    grid = expand_grid(
+        learning_rate=[0.1, 0.05, 0.01],
+        num_leaves=[31, 63, 127],
+        min_data_in_leaf=[20, 40],
+        feature_fraction=[0.8, 1.0],
+        bagging_fraction=[0.6, 0.8, 1.0],
+        bagging_freq=[4],
+        nthread=[4],
+    )
+    assert len(grid) == 108
+    led = SweepLedger(grid, REF)
+    n_done = sum(led.done(i) for i in range(108))
+    assert n_done == 80
+    for i in range(108):
+        if not led.done(i):
+            assert led.rows[i]["learning_rate"] == 0.01

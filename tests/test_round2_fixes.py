@@ -1,0 +1,132 @@
+"""Round-2 fixes: init_model continuation, leaf renewal, ADVICE items."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def reg_data():
+    rng = np.random.default_rng(42)
+    n = 2000
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (X[:, 0] * 2 + np.sin(X[:, 1] * 3) + 0.5 * X[:, 2] * X[:, 3]
+         + rng.normal(0, 0.1, n)).astype(np.float32)
+    return X, y
+
+
+def _rmse(a, b):
+    return float(np.sqrt(np.mean((a - b) ** 2)))
+
+
+def test_init_model_continuation_matches_single_run(reg_data):
+    """20 rounds == 10 rounds + init_model continuation of 10 more
+    (same params, same data => identical trees)."""
+    X, y = reg_data
+    params = {"objective": "regression", "num_leaves": 15,
+              "learning_rate": 0.2, "verbosity": -1}
+    full = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                     num_boost_round=20)
+    part = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                     num_boost_round=10)
+    cont = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                     num_boost_round=10, init_model=part)
+    assert cont.num_trees() == 20
+    np.testing.assert_allclose(full.predict(X), cont.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_init_model_from_file_and_different_lr(reg_data):
+    X, y = reg_data
+    p1 = {"objective": "regression", "num_leaves": 15,
+          "learning_rate": 0.3, "verbosity": -1}
+    first = lgb.train(p1, lgb.Dataset(X, label=y), num_boost_round=8)
+    pred_first = first.predict(X)
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m.json")
+        first.save_model(path)
+        # continue with different lr AND different num_leaves
+        p2 = {"objective": "regression", "num_leaves": 7,
+              "learning_rate": 0.05, "verbosity": -1}
+        cont = lgb.train(p2, lgb.Dataset(X, label=y), num_boost_round=5,
+                         init_model=path)
+    assert cont.num_trees() == 13
+    # first 8 trees' contribution preserved exactly
+    np.testing.assert_allclose(cont.predict(X, num_iteration=8), pred_first,
+                               rtol=1e-4, atol=1e-5)
+    # continuation improves training loss
+    assert _rmse(cont.predict(X), y) < _rmse(pred_first, y)
+
+
+def test_l1_leaf_renewal_beats_plain_surrogate(reg_data):
+    """Median leaf renewal must improve MAE on a skewed-noise target."""
+    X, _ = reg_data
+    rng = np.random.default_rng(1)
+    # heavy-tailed asymmetric noise: renewal matters here
+    y = (X[:, 0] * 2 + rng.exponential(1.0, len(X)).astype(np.float32))
+    params = {"objective": "l1", "num_leaves": 31, "learning_rate": 0.2,
+              "verbosity": -1}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=60)
+    mae = float(np.mean(np.abs(b.predict(X) - y)))
+    # oracle check: sklearn LAD GBDT
+    from sklearn.ensemble import HistGradientBoostingRegressor
+    orc = HistGradientBoostingRegressor(
+        loss="absolute_error", max_iter=60, learning_rate=0.2,
+        max_leaf_nodes=31).fit(X, y)
+    mae_orc = float(np.mean(np.abs(orc.predict(X) - y)))
+    assert mae < mae_orc * 1.2, (mae, mae_orc)
+
+
+def test_quantile_init_score_and_renewal(reg_data):
+    """Quantile objective: init at the alpha-quantile + quantile renewal;
+    the empirical coverage of predictions must approximate alpha."""
+    X, _ = reg_data
+    rng = np.random.default_rng(2)
+    y = (X[:, 0] + rng.normal(0, 1.0, len(X))).astype(np.float32)
+    for alpha in (0.1, 0.9):
+        params = {"objective": "quantile", "alpha": alpha,
+                  "num_leaves": 31, "learning_rate": 0.1, "verbosity": -1}
+        b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=80)
+        cover = float(np.mean(y <= b.predict(X)))
+        assert abs(cover - alpha) < 0.06, (alpha, cover)
+
+
+def test_pred_leaf_returns_leaf_ordinals(reg_data):
+    X, y = reg_data
+    params = {"objective": "regression", "num_leaves": 15, "verbosity": -1}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    leaves = b.predict(X[:100], pred_leaf=True)
+    assert leaves.shape == (100, 5)
+    assert leaves.min() >= 0
+    assert leaves.max() < 15  # ordinals in [0, num_leaves)
+
+
+def test_feature_importance_explicit_iteration(reg_data):
+    X, y = reg_data
+    params = {"objective": "regression", "num_leaves": 15, "verbosity": -1}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10)
+    all_imp = b.feature_importance()
+    assert all_imp.sum() == sum(
+        int(np.sum(~np.asarray(t.is_leaf) & (np.asarray(t.left) >= 0)))
+        for t in b.trees)
+    half = b.feature_importance(iteration=5)
+    assert half.sum() < all_imp.sum()
+    gains = b.feature_importance(importance_type="gain")
+    assert gains.dtype == np.float64 and gains.sum() > 0
+    # informative feature 0 must dominate
+    assert np.argmax(gains) == 0
+
+
+def test_nan_at_predict_maps_to_zero_bin(reg_data):
+    """Feature with no NaN at fit time: NaN at predict falls in the bin
+    containing 0.0 (LightGBM missing->zero convention)."""
+    X, y = reg_data
+    params = {"objective": "regression", "num_leaves": 15, "verbosity": -1}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10)
+    Xq = X[:10].copy()
+    Xz = Xq.copy(); Xz[:, 0] = 0.0
+    Xn = Xq.copy(); Xn[:, 0] = np.nan
+    np.testing.assert_allclose(b.predict(Xn), b.predict(Xz),
+                               rtol=1e-5, atol=1e-6)

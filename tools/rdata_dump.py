@@ -1,0 +1,18 @@
+"""CLI: print the contents of an .RData sweep checkpoint as a table.
+
+Usage: python tools/rdata_dump.py paramGrid.RData
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+from lightgbm_tpu.utils.rdata import read_rdata
+
+if __name__ == "__main__":
+    for name, df in read_rdata(sys.argv[1]).items():
+        cols = list(df.keys())
+        print(f"== {name} ({len(df[cols[0]])} rows) ==")
+        print("\t".join(cols))
+        for i in range(len(df[cols[0]])):
+            print("\t".join(str(df[c][i]) for c in cols))
