@@ -134,6 +134,16 @@ _ALIASES: Dict[str, str] = {
     "efb": "enable_bundle",
     "is_enable_bundle": "enable_bundle",
     "max_conflict_rate": "max_conflict_rate",
+    "cat_smooth": "cat_smooth",
+    "cat_l2": "cat_l2",
+    "max_cat_threshold": "max_cat_threshold",
+    "drop_rate": "drop_rate",
+    "rate_drop": "drop_rate",
+    "max_drop": "max_drop",
+    "skip_drop": "skip_drop",
+    "xgboost_dart_mode": "xgboost_dart_mode",
+    "uniform_drop": "uniform_drop",
+    "drop_seed": "drop_seed",
     "use_missing": "use_missing",
     "zero_as_missing": "zero_as_missing",
     "boost_from_average": "boost_from_average",
@@ -317,6 +327,17 @@ class Params:
     max_conflict_rate: float = 0.0
     use_missing: bool = True
     zero_as_missing: bool = False
+    # categorical subset splits (upstream cat_smooth/cat_l2/max_cat_threshold)
+    cat_smooth: float = 10.0
+    cat_l2: float = 10.0
+    max_cat_threshold: int = 32
+    # DART boosting (upstream dart.hpp knobs)
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    xgboost_dart_mode: bool = False
+    uniform_drop: bool = False
+    drop_seed: int = 4
     # objective-specific
     boost_from_average: bool = True
     num_class: int = 1
@@ -476,8 +497,9 @@ def _validate(p: Params) -> None:
         if p.top_rate + p.other_rate > 1.0:
             raise ValueError("goss requires top_rate + other_rate <= 1")
     if p.boosting == "dart":
-        raise NotImplementedError(
-            "boosting='dart' is not implemented; use gbdt, goss or rf")
+        if not (0.0 <= p.drop_rate <= 1.0) or not (0.0 <= p.skip_drop <= 1.0):
+            raise ValueError("dart requires 0<=drop_rate<=1 and "
+                             "0<=skip_drop<=1")
 
 
 def default_metric_for_objective(objective: str) -> str:

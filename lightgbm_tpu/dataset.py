@@ -26,6 +26,152 @@ from .config import Params, parse_params
 ROW_PAD_MULTIPLE = 256  # lane-friendly and shard-friendly (divides by 2,4,8 devices)
 
 
+class FeatureBundler:
+    """Exclusive Feature Bundling (EFB) — LightGBM's sparse-feature trick.
+
+    Mutually-exclusive sparse features (rarely non-default on the same row)
+    are merged into one histogram column whose bin axis concatenates the
+    members' non-default bin ranges; histogram passes then scale with the
+    number of BUNDLES, not features (upstream ``FindGroups``/``EFB`` in
+    dataset construction; SURVEY.md §2C EFB row, BASELINE.md Criteo config).
+
+    TPU-native formulation: bundling is a pure host-side recoding at bin
+    time (uint8 in, uint8 out), so the device pipeline is unchanged — the
+    binned matrix just has fewer columns.  Splits are found on the merged
+    bin axis directly; a threshold inside member f's range separates f's
+    values (plus all earlier members on the left / later on the right),
+    a strict superset of the per-member thresholds upstream scans.
+
+    ``groups`` covers every original feature exactly once; singleton groups
+    pass through unchanged.  Merged code layout per multi-feature group:
+    bin 0 = every member at its default bin; member j's non-default bins
+    occupy ``[offset_j, offset_j + n_bins_j - 2]`` (its default bin is
+    squeezed out).  Conflicting rows (two members non-default — allowed up
+    to ``max_conflict_rate``) keep the LAST member's value.
+    """
+
+    def __init__(self, groups: List[List[int]], member_bins: np.ndarray,
+                 default_bins: np.ndarray):
+        self.groups = [list(map(int, g)) for g in groups]
+        self.member_bins = np.asarray(member_bins, np.int64)
+        self.default_bins = np.asarray(default_bins, np.int64)
+        self.offsets: List[Optional[np.ndarray]] = []
+        self.col_bins: List[int] = []
+        for g in self.groups:
+            if len(g) == 1:
+                self.offsets.append(None)
+                self.col_bins.append(int(self.member_bins[g[0]]))
+            else:
+                offs, o = [], 1
+                for f in g:
+                    offs.append(o)
+                    o += int(self.member_bins[f]) - 1
+                self.offsets.append(np.asarray(offs, np.int64))
+                self.col_bins.append(o)
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.groups)
+
+    @property
+    def max_col_bins(self) -> int:
+        return max(self.col_bins)
+
+    def merge(self, codes: np.ndarray) -> np.ndarray:
+        """Original per-feature codes [n, F] -> bundled codes [n, B]."""
+        out = np.zeros((codes.shape[0], len(self.groups)), np.uint8)
+        for c, g in enumerate(self.groups):
+            if len(g) == 1:
+                out[:, c] = codes[:, g[0]]
+                continue
+            col = np.zeros(codes.shape[0], np.int64)
+            for f, o in zip(g, self.offsets[c]):
+                cf = codes[:, f].astype(np.int64)
+                dflt = self.default_bins[f]
+                nz = cf != dflt
+                adj = cf - (cf > dflt)
+                col = np.where(nz, o + adj, col)
+            out[:, c] = col.astype(np.uint8)
+        return out
+
+    def split_to_original(self, cols: np.ndarray,
+                          bins: np.ndarray) -> np.ndarray:
+        """Map (bundled column, threshold bin) of tree splits back to the
+        original feature index (for feature_importance / model dumps).
+        A threshold inside member j's range is attributed to member j;
+        bin 0 (the all-default slot) attributes to the first member."""
+        cols = np.asarray(cols, np.int64)
+        bins = np.asarray(bins, np.int64)
+        out = np.empty_like(cols)
+        for c, g in enumerate(self.groups):
+            m = cols == c
+            if not m.any():
+                continue
+            if len(g) == 1:
+                out[m] = g[0]
+            else:
+                j = np.searchsorted(self.offsets[c], bins[m],
+                                    side="right") - 1
+                out[m] = np.asarray(g)[np.clip(j, 0, len(g) - 1)]
+        return out
+
+    @staticmethod
+    def fit(codes: np.ndarray, n_bins: np.ndarray,
+            max_conflict_rate: float = 0.0, max_merged_bins: int = 256,
+            sparse_threshold: float = 0.8, sample: int = 50_000,
+            exclude: Optional[np.ndarray] = None
+            ) -> Optional["FeatureBundler"]:
+        """Greedy conflict-bounded bundling (upstream FindGroups).
+
+        Only sufficiently sparse features (default-bin frequency >=
+        ``sparse_threshold``, LightGBM's kSparseThreshold) are candidates;
+        returns None when no multi-feature bundle forms (bundling dense
+        data would only distort histograms for zero gain).
+        """
+        n, num_features = codes.shape
+        if num_features < 3:
+            return None
+        samp = codes[: min(n, sample)]
+        ns = len(samp)
+        default_bins = np.array(
+            [np.bincount(samp[:, f], minlength=int(n_bins[f])).argmax()
+             for f in range(num_features)], np.int64)
+        nondef = samp != default_bins[None, :]
+        nd_count = nondef.sum(axis=0)
+        eligible = nd_count <= (1.0 - sparse_threshold) * ns
+        if exclude is not None:
+            eligible &= ~np.asarray(exclude, bool)
+        budget = max_conflict_rate * ns
+
+        order = np.argsort(-nd_count)
+        bundles: List[dict] = []
+        for f in order:
+            f = int(f)
+            if not eligible[f]:
+                continue
+            placed = False
+            for b in bundles:
+                extra = int(np.count_nonzero(b["occ"] & nondef[:, f]))
+                if (b["conflicts"] + extra <= budget
+                        and b["bins"] + int(n_bins[f]) - 1 <= max_merged_bins):
+                    b["members"].append(f)
+                    b["occ"] |= nondef[:, f]
+                    b["conflicts"] += extra
+                    b["bins"] += int(n_bins[f]) - 1
+                    placed = True
+                    break
+            if not placed:
+                bundles.append({"members": [f], "occ": nondef[:, f].copy(),
+                                "conflicts": 0, "bins": 1 + int(n_bins[f]) - 1})
+        multi = [b for b in bundles if len(b["members"]) > 1]
+        if not multi:
+            return None
+        bundled_feats = {f for b in multi for f in b["members"]}
+        groups = [[f] for f in range(num_features) if f not in bundled_feats]
+        groups += [sorted(b["members"]) for b in multi]
+        return FeatureBundler(groups, n_bins, default_bins)
+
+
 class BinMapper:
     """Per-feature quantile binning table (LightGBM BinMapper equivalent).
 
@@ -45,9 +191,12 @@ class BinMapper:
             is_categorical if is_categorical is not None
             else np.zeros(self.num_features, dtype=bool)
         )
+        self.bundler: Optional[FeatureBundler] = None  # EFB (attach post-fit)
 
     @property
     def max_num_bins(self) -> int:
+        if self.bundler is not None:
+            return self.bundler.max_col_bins
         return int(self.n_bins.max()) if len(self.n_bins) else 1
 
     @staticmethod
@@ -82,10 +231,10 @@ class BinMapper:
             vals = col[~np.isnan(col)]
             budget = max_bin - (1 if has_nan else 0)
             if f in cat:
-                # categorical: one bin per kept category value (exact match at
-                # transform time; unseen/rare values share the overflow bin).
-                # NOTE: splits over these bins are still ordered thresholds;
-                # LightGBM-style subset splits are milestone M4.
+                # categorical: one bin per kept category value (exact match
+                # at transform time; unseen/rare values share the overflow
+                # bin).  The grower finds gradient-ordered k-vs-rest SUBSET
+                # splits over these bins (ops.split CatInfo path).
                 is_cat[f] = True
                 cats = np.unique(vals)
                 if len(cats) > budget - 1:
@@ -133,7 +282,14 @@ class BinMapper:
         return BinMapper(bounds, nan_bin, n_bins, is_cat)
 
     def transform(self, X: np.ndarray) -> np.ndarray:
-        """Map raw features to bin codes uint8[n, F]."""
+        """Map raw features to bin codes uint8[n, F] (bundled columns when
+        EFB is active — the training and predict paths must agree)."""
+        codes = self._transform_unbundled(X)
+        if self.bundler is not None:
+            return self.bundler.merge(codes)
+        return codes
+
+    def _transform_unbundled(self, X: np.ndarray) -> np.ndarray:
         n, num_features = X.shape
         assert num_features == self.num_features, (
             f"feature count mismatch: {num_features} vs {self.num_features}")
@@ -227,7 +383,13 @@ class Dataset:
         self._feature_name_arg = feature_name
         self._categorical_feature_arg = categorical_feature
 
-        self.bin_mapper: Optional[BinMapper] = reference.bin_mapper if reference is not None else None
+        # the reference's mapper is resolved lazily at construct() time: at
+        # creation the reference may not be constructed yet (the standard
+        # create_valid-before-train pattern), and binding None here would
+        # silently fit a DIFFERENT binning for the valid set
+        self._reference: Optional["Dataset"] = reference
+        self.bin_mapper: Optional[BinMapper] = (
+            reference.bin_mapper if reference is not None else None)
         self._constructed = False
         self.num_data_: Optional[int] = None
         self.num_feature_: Optional[int] = None
@@ -245,8 +407,11 @@ class Dataset:
         return int(self.num_data_)
 
     def num_feature(self) -> int:
+        """Original (pre-EFB) feature count — the user-facing surface; the
+        training column count is ``num_feature_`` (fewer when bundled)."""
         self.construct()
-        return int(self.num_feature_)
+        return int(getattr(self, "raw_num_feature_", None)
+                   or self.num_feature_)
 
     def get_label(self) -> Optional[np.ndarray]:
         return self._label
@@ -330,11 +495,28 @@ class Dataset:
         self.feature_names = self._resolve_feature_names(num_features)
         cat_idx = self._resolve_categorical(self.feature_names)
 
+        if self.bin_mapper is None and self._reference is not None:
+            self._reference.construct()
+            self.bin_mapper = self._reference.bin_mapper
+        codes = None
         if self.bin_mapper is None:
             self.bin_mapper = BinMapper.fit(
                 X, max_bin=p.max_bin, min_data_in_bin=p.min_data_in_bin,
                 categorical=cat_idx, seed=p.data_random_seed)
-        codes = self.bin_mapper.transform(X)
+            raw_codes = self.bin_mapper._transform_unbundled(X)
+            if p.enable_bundle:
+                self.bin_mapper.bundler = FeatureBundler.fit(
+                    raw_codes, self.bin_mapper.n_bins,
+                    max_conflict_rate=p.max_conflict_rate,
+                    exclude=self.bin_mapper.is_categorical)
+            b = self.bin_mapper.bundler
+            codes = raw_codes if b is None else b.merge(raw_codes)
+        if codes is None:
+            codes = self.bin_mapper.transform(X)
+        self.raw_num_feature_ = num_features
+        if self.bin_mapper.bundler is not None:
+            num_features = codes.shape[1]
+            self.num_feature_ = num_features
 
         n_pad = -(-n // ROW_PAD_MULTIPLE) * ROW_PAD_MULTIPLE
         pad = n_pad - n
@@ -419,3 +601,14 @@ class Dataset:
         """Padded bin-axis size (power-of-two-ish for kernel friendliness)."""
         self.construct()
         return max(2, self.bin_mapper.max_num_bins)
+
+    @property
+    def col_is_categorical(self) -> np.ndarray:
+        """Categorical flag per TRAINING column (post-EFB: bundled columns
+        are never categorical — categoricals are excluded from bundling)."""
+        self.construct()
+        raw = self.bin_mapper.is_categorical
+        b = self.bin_mapper.bundler
+        if b is None:
+            return np.asarray(raw, bool)
+        return np.array([len(g) == 1 and bool(raw[g[0]]) for g in b.groups])

@@ -261,7 +261,7 @@ def cv(
     # program; SURVEY.md §3.2 "TPU mapping") -----------------------------
     from .models.fused import fused_cv_eligible, run_fused_cv_batch
 
-    if (fused_cv_eligible(p, feval, callbacks)
+    if (fused_cv_eligible(p, feval, callbacks, train_set)
             and not return_cvbooster and not eval_train_metric
             and verbose_eval in (None, False)):
         fold_masks = np.zeros((len(folds), n), dtype=bool)

@@ -165,9 +165,14 @@ def _fused_cv_fn(obj_key: tuple, num_leaves: int, num_bins: int,
     return run_segment, init_carry, finalize
 
 
-def fused_cv_eligible(p: Params, feval, callbacks) -> bool:
+def fused_cv_eligible(p: Params, feval, callbacks, train_set=None) -> bool:
     """The fused path covers the reference's cv contract; anything needing
-    per-round host hooks falls back to the host loop."""
+    per-round host hooks falls back to the host loop.
+
+    Pass ``train_set`` to also apply dataset-dependent exclusions
+    (categorical subset splits need the strict grower's cat path, which the
+    fused batch program does not trace yet).
+    """
     if feval is not None or callbacks:
         return False
     if p.extra.get("fobj") is not None:
@@ -178,6 +183,8 @@ def fused_cv_eligible(p: Params, feval, callbacks) -> bool:
     if len(metrics) > 1:
         return False
     if p.boosting not in ("gbdt",):
+        return False
+    if train_set is not None and bool(np.any(train_set.col_is_categorical)):
         return False
     return True
 

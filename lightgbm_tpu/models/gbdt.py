@@ -141,6 +141,23 @@ def _objective_static_key(obj: Objective, p: Params) -> tuple:
     )
 
 
+def _build_cat_info(cat_key, num_features: int):
+    """Static cat_key -> traced CatInfo (None passthrough).
+
+    cat_key = (tuple of categorical column indices, cat_smooth, cat_l2,
+    max_cat_threshold) — static so the compiled program specializes on
+    WHICH columns take subset splits.
+    """
+    if cat_key is None:
+        return None
+    from ..ops.split import CatInfo
+
+    idx, smooth, l2, mct = cat_key
+    is_cat = jnp.zeros(num_features, bool).at[jnp.asarray(idx)].set(True)
+    return CatInfo(is_cat=is_cat, cat_smooth=jnp.float32(smooth),
+                   cat_l2=jnp.float32(l2), max_cat_threshold=int(mct))
+
+
 def _rebuild_objective(key: tuple) -> Objective:
     if key and key[0] == "__group_objective__":
         return key[1]
@@ -164,9 +181,11 @@ def _rebuild_objective(key: tuple) -> Objective:
 def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
               hist_impl: str, row_chunk: int, is_rf: bool,
               num_class: int = 1, hist_dtype: str = "f32",
-              wave_width: int = 1, goss_k: Optional[Tuple[int, int]] = None):
+              wave_width: int = 1, goss_k: Optional[Tuple[int, int]] = None,
+              cat_key: Optional[tuple] = None):
     """goss_k: static (k_top, k_other) row counts enabling the compacted
-    GOSS path; None = plain gbdt/rf."""
+    GOSS path; None = plain gbdt/rf.  cat_key: static categorical-split
+    configuration (see _build_cat_info)."""
     obj = _rebuild_objective(obj_key)
     is_goss = goss_k is not None
     renew_alpha = getattr(obj, "renew_alpha", None)
@@ -197,7 +216,8 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                     num_bins, hyper.max_depth,
                     ff_bynode=hyper.feature_fraction_bynode, key=kc,
                     hist_impl=hist_impl, row_chunk=row_chunk,
-                    hist_dtype=hist_dtype, wave_width=wave_width)
+                    hist_dtype=hist_dtype, wave_width=wave_width,
+                    cat_info=_build_cat_info(cat_key, bins.shape[1]))
 
             keys = jax.random.split(key, num_class)
             trees, row_leafs = jax.vmap(grow_one, in_axes=(1, 1, 0))(
@@ -243,7 +263,8 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                 num_bins, hyper.max_depth,
                 ff_bynode=hyper.feature_fraction_bynode, key=key,
                 hist_impl=hist_impl, row_chunk=row_chunk,
-                hist_dtype=hist_dtype, wave_width=wave_width)
+                hist_dtype=hist_dtype, wave_width=wave_width,
+                cat_info=_build_cat_info(cat_key, bins.shape[1]))
             if renew_alpha is not None:
                 tree = renew_leaf_values(
                     tree, rl_c, y[idx] - pred[idx], w[idx] * wt, renew_alpha)
@@ -263,7 +284,8 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
             bins, stats, feature_mask, hyper.ctx(), num_leaves, num_bins,
             hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
             key=key, hist_impl=hist_impl, row_chunk=row_chunk,
-            hist_dtype=hist_dtype, wave_width=wave_width)
+            hist_dtype=hist_dtype, wave_width=wave_width,
+            cat_info=_build_cat_info(cat_key, bins.shape[1]))
         if renew_alpha is not None:
             tree = renew_leaf_values(tree, row_leaf, y - pred, w * bag,
                                      renew_alpha)
@@ -278,7 +300,8 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
 def _multi_round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                     hist_impl: str, row_chunk: int, is_rf: bool,
                     hist_dtype: str, wave_width: int, n_rounds: int,
-                    bagging_freq: int, use_ff: bool):
+                    bagging_freq: int, use_ff: bool,
+                    cat_key: Optional[tuple] = None):
     """``n_rounds`` boosting rounds as ONE device program (`lax.scan`).
 
     The host round loop pays a dispatch round-trip per boosting round —
@@ -324,7 +347,8 @@ def _multi_round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                 hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
                 key=jax.random.fold_in(round_key, i), hist_impl=hist_impl,
                 row_chunk=row_chunk, hist_dtype=hist_dtype,
-                wave_width=wave_width)
+                wave_width=wave_width,
+                cat_info=_build_cat_info(cat_key, bins.shape[1]))
             if renew_alpha is not None:
                 tree = renew_leaf_values(tree, row_leaf, y - pred, w * bag,
                                          renew_alpha)
@@ -455,9 +479,10 @@ class Booster:
             self.obj.set_group(gs, y_host, int(ds.row_mask.shape[0]))
         k = self._num_class
         if k > 1:
-            if p.boosting == "rf":
-                raise NotImplementedError("rf boosting with multiclass is "
-                                          "not supported yet")
+            if p.boosting in ("rf", "dart"):
+                raise NotImplementedError(
+                    f"{p.boosting} boosting with multiclass is not "
+                    "supported yet")
             self.init_score_ = np.asarray(
                 self.obj.init_score(y_host, w_host), np.float32)  # [K]
             if ds.get_init_score() is not None:
@@ -481,6 +506,11 @@ class Booster:
         self._obj_key = _objective_static_key(self.obj, p)
         self._num_bins = ds.num_bins
         self._w_eff = ds.w  # 0 on padding rows already
+        cats = np.flatnonzero(ds.col_is_categorical)
+        self._cat_key = (
+            (tuple(int(c) for c in cats), float(p.cat_smooth),
+             float(p.cat_l2), int(p.max_cat_threshold))
+            if len(cats) else None)
         self._dp_mesh = None
         if p.tree_learner in ("data", "feature", "voting"):
             self._maybe_setup_dp()
@@ -498,9 +528,10 @@ class Booster:
         import warnings
 
         p = self.params
-        if (self._num_class > 1 or p.boosting == "goss"
+        if (self._num_class > 1 or p.boosting in ("goss", "dart")
                 or getattr(self.obj, "needs_group", False)
-                or getattr(self.obj, "renew_alpha", None) is not None):
+                or getattr(self.obj, "renew_alpha", None) is not None
+                or self._cat_key is not None):
             warnings.warn(
                 f"tree_learner='{p.tree_learner}' currently supports "
                 "single-output non-ranking gbdt/rf boosting; training "
@@ -602,16 +633,14 @@ class Booster:
             self._pred_train = add(self._pred_train, tree, ds.X_binned,
                                    shrink)
 
-    # -- round step ------------------------------------------------------
-    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
-        """Run one boosting round (LightGBM Booster.update)."""
-        if train_set is not None and train_set is not self.train_set:
-            self.train_set = train_set
-            self._setup_training()
+    def _sample_bag_and_fmask(self, i: int):
+        """Per-round stochasticity shared by plain and DART rounds: resample
+        the bagging mask on schedule (updating ``self._bag``, kept
+        mesh-sharded under DP) and return this round's feature mask.  RNG
+        streams are keyed by round index so any round path reproduces the
+        same draws."""
         ds = self.train_set
         p = self.params
-        i = self._iter
-
         if p.bagging_freq > 0 and p.bagging_fraction < 1.0 and \
                 i % p.bagging_freq == 0:
             bkey = jax.random.fold_in(
@@ -624,13 +653,27 @@ class Booster:
                 # device, and leaving it there would reshard every round
                 from ..parallel.data_parallel import shard_rows
                 self._bag = shard_rows(self._dp_mesh, self._bag)
+        n_cols = int(ds.X_binned.shape[1])
         if p.feature_fraction < 1.0:
             fkey = jax.random.fold_in(
                 jax.random.PRNGKey(p.feature_fraction_seed + p.seed), i)
-            fmask = _feature_mask_fn(ds.num_feature_)(
+            return _feature_mask_fn(n_cols)(
                 fkey, jnp.float32(p.feature_fraction))
-        else:
-            fmask = jnp.ones(ds.num_feature_, jnp.float32)
+        return jnp.ones(n_cols, jnp.float32)
+
+    # -- round step ------------------------------------------------------
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """Run one boosting round (LightGBM Booster.update)."""
+        if train_set is not None and train_set is not self.train_set:
+            self.train_set = train_set
+            self._setup_training()
+        if self.params.boosting == "dart":
+            return self._dart_round()
+        ds = self.train_set
+        p = self.params
+        i = self._iter
+
+        fmask = self._sample_bag_and_fmask(i)
 
         goss_k = None
         eff_rows = int(ds.row_mask.shape[0])
@@ -658,7 +701,8 @@ class Booster:
                            int(p.extra.get("row_chunk", 131072)),
                            p.boosting == "rf", self._num_class,
                            resolve_hist_dtype(p, eff_rows),
-                           resolve_wave_width(p, eff_rows), goss_k)
+                           resolve_wave_width(p, eff_rows), goss_k,
+                           self._cat_key)
             tree, new_pred = fn(ds.X_binned, ds.y, self._w_eff, self._bag,
                                 self._pred_train, fmask, self._hyper,
                                 round_key)
@@ -723,7 +767,8 @@ class Booster:
                 int(p.extra.get("row_chunk", 131072)), p.boosting == "rf",
                 resolve_hist_dtype(p, eff_rows),
                 resolve_wave_width(p, eff_rows), n_rounds,
-                p.bagging_freq if use_bagging else 0, use_ff)
+                p.bagging_freq if use_bagging else 0, use_ff,
+                self._cat_key)
             pred, bag, trees = fn(
                 ds.X_binned, ds.y, self._w_eff, self._bag, self._pred_train,
                 self._hyper, self._key, bag_key, ff_key, ds.row_mask,
@@ -737,6 +782,90 @@ class Booster:
             self._iter += n_rounds
             self._forest_cache = None
             k -= n_rounds
+
+    def _dart_round(self) -> bool:
+        """One DART boosting round (upstream dart.hpp semantics).
+
+        A random subset of existing trees is "dropped": the new tree fits
+        gradients of the ensemble WITHOUT them, then (non-xgboost mode) the
+        new tree is scaled by 1/(k+1) and each dropped tree rescaled to
+        k/(k+1) so the expected ensemble output is preserved (MART's
+        shrinkage-induced over-specialization fix — Rashmi &
+        Gilad-Bachrach 2015).  Stored leaf values carry the DART scales
+        directly, so the uniform learning-rate shrink at predict time stays
+        correct; with probability ``skip_drop`` a round degenerates to
+        plain gbdt.
+        """
+        ds = self.train_set
+        p = self.params
+        i = self._iter
+        fmask = self._sample_bag_and_fmask(i)
+
+        rng = np.random.default_rng(p.drop_seed + p.seed + i * 7919)
+        n_t = len(self.trees)
+        dropped: List[int] = []
+        if n_t > 0 and p.drop_rate > 0 and rng.random() >= p.skip_drop:
+            m = rng.random(n_t) < p.drop_rate
+            dropped = [int(t) for t in np.flatnonzero(m)]
+            if p.max_drop > 0 and len(dropped) > p.max_drop:
+                dropped = sorted(
+                    int(t) for t in rng.choice(dropped, p.max_drop,
+                                               replace=False))
+        k = len(dropped)
+        lr = jnp.float32(p.learning_rate)
+        add = _tree_pred_fn(self._depth_cap, 1)
+
+        pred = self._pred_train
+        for t in dropped:
+            pred = add(pred, self.trees[t], ds.X_binned, -lr)
+
+        eff_rows = int(ds.row_mask.shape[0])
+        fn = _round_fn(self._obj_key, p.num_leaves, self._num_bins,
+                       p.extra.get("hist_impl", "auto"),
+                       int(p.extra.get("row_chunk", 131072)), False, 1,
+                       resolve_hist_dtype(p, eff_rows),
+                       resolve_wave_width(p, eff_rows), None, self._cat_key)
+        round_key = jax.random.fold_in(self._key, i)
+        tree, new_pred = fn(ds.X_binned, ds.y, self._w_eff, self._bag, pred,
+                            fmask, self._hyper, round_key)
+
+        if k > 0:
+            # upstream Normalize(): on drop rounds the new tree's weight is
+            # 1/(k+1) (xgboost mode: lr/(k+lr)) INSTEAD of the learning
+            # rate, and dropped trees rescale to k/(k+1) (resp. k/(k+lr)).
+            # Stored values are raw (uniform lr applied at predict), so the
+            # baked factor divides lr back out.
+            lr_f = float(p.learning_rate)
+            if p.xgboost_dart_mode:
+                new_scale = 1.0 / (k + lr_f)
+                drop_scale = k / (k + lr_f)
+            else:
+                new_scale = 1.0 / ((k + 1.0) * lr_f)
+                drop_scale = k / (k + 1.0)
+            tree = tree._replace(
+                leaf_value=tree.leaf_value * jnp.float32(new_scale))
+            new_pred = pred + (new_pred - pred) * jnp.float32(new_scale)
+            # valid-set deltas from rescaling dropped trees, using the OLD
+            # leaf values (before they are overwritten below)
+            for idx, (name, vds, vpred) in enumerate(self._valid):
+                for t in dropped:
+                    vpred = add(vpred, self.trees[t], vds.X_binned,
+                                lr * jnp.float32(drop_scale - 1.0))
+                self._valid[idx] = (name, vds, vpred)
+            for t in dropped:
+                self.trees[t] = self.trees[t]._replace(
+                    leaf_value=self.trees[t].leaf_value
+                    * jnp.float32(drop_scale))
+                new_pred = add(new_pred, self.trees[t], ds.X_binned, lr)
+
+        self._pred_train = new_pred
+        self.trees.append(tree)
+        self._forest_cache = None
+        for idx, (name, vds, vpred) in enumerate(self._valid):
+            self._valid[idx] = (name, vds,
+                                add(vpred, tree, vds.X_binned, lr))
+        self._iter += 1
+        return False
 
     # -- evaluation ------------------------------------------------------
     def _metric_names(self) -> List[str]:
@@ -943,7 +1072,11 @@ class Booster:
             feat = tree.split_feature[node]
             thr = tree.split_bin[node]
             code = jnp.take_along_axis(b32, feat[:, None], axis=1)[:, 0]
-            nxt = jnp.where(code <= thr, tree.left[node], tree.right[node])
+            left = code <= thr
+            if tree.is_cat_split is not None:
+                left = jnp.where(tree.is_cat_split[node],
+                                 tree.cat_mask[node, code], left)
+            nxt = jnp.where(left, tree.left[node], tree.right[node])
             return jnp.where(tree.is_leaf[node], node, nxt), None
 
         node, _ = lax.scan(step, jnp.zeros(n, jnp.int32), None,
@@ -997,6 +1130,12 @@ class Booster:
         # have a child written (unused slots keep left == -1)
         used = (~np.asarray(forest.is_leaf).ravel()
                 & (np.asarray(forest.left).ravel() >= 0))
+        bundler = getattr(self._bin_mapper_for_predict(), "bundler", None)
+        if bundler is not None:
+            # splits reference EFB bundle columns; attribute each to the
+            # original feature whose bin range holds the threshold
+            bins_thr = np.asarray(forest.split_bin).ravel()
+            feats = bundler.split_to_original(feats, bins_thr)
         vals = (np.ones_like(gains) if importance_type == "split" else gains)
         np.add.at(out, feats[used], vals[used])
         if importance_type == "split":

@@ -43,7 +43,9 @@ class Tree(NamedTuple):
     """One tensorized decision tree (node arrays of length 2*num_leaves-1).
 
     Traversal rule at internal node i: go left iff
-    ``bin_code[row, split_feature[i]] <= split_bin[i]``.
+    ``bin_code[row, split_feature[i]] <= split_bin[i]`` for numeric splits;
+    for categorical k-vs-rest splits (``is_cat_split[i]``) go left iff
+    ``cat_mask[i, bin_code[row, split_feature[i]]]``.
     Unused slots have ``is_leaf=False`` and are unreachable.
     """
 
@@ -56,6 +58,9 @@ class Tree(NamedTuple):
     count: jnp.ndarray          # f32[M] rows that reached the node (bagged)
     split_gain: jnp.ndarray     # f32[M] gain of the split at internal nodes
     num_leaves: jnp.ndarray     # i32[] leaves actually grown
+    # categorical subset splits — None for datasets without categoricals
+    is_cat_split: Optional[jnp.ndarray] = None  # bool[M]
+    cat_mask: Optional[jnp.ndarray] = None      # bool[M, B] bins going LEFT
 
     @property
     def capacity(self) -> int:
@@ -88,6 +93,9 @@ class _GrowState(NamedTuple):
     n_nodes: jnp.ndarray        # i32[]
     n_leaves: jnp.ndarray       # i32[]
     done: jnp.ndarray           # bool[]
+    # categorical candidate splits (None when the dataset has none)
+    cand_cat: Optional[jnp.ndarray] = None      # bool[M]
+    cand_catmask: Optional[jnp.ndarray] = None  # bool[M, B]
 
 
 def _write(arr, idx, val, active):
@@ -158,12 +166,22 @@ def pad_tree(tree: Tree, capacity: int) -> Tree:
     def p(a, val=0):
         return jnp.pad(a, pad, constant_values=val)
 
+    def p_node2(a, val=False):
+        """Pad the NODE axis of a [..., M, B] array (cat_mask)."""
+        pads = [(0, 0)] * a.ndim
+        pads[-2] = (0, capacity - m)
+        return jnp.pad(a, pads, constant_values=val)
+
     return Tree(
         split_feature=p(tree.split_feature), split_bin=p(tree.split_bin),
         left=p(tree.left, -1), right=p(tree.right, -1),
         leaf_value=p(tree.leaf_value), is_leaf=p(tree.is_leaf, False),
         count=p(tree.count), split_gain=p(tree.split_gain),
-        num_leaves=tree.num_leaves)
+        num_leaves=tree.num_leaves,
+        is_cat_split=(None if tree.is_cat_split is None
+                      else p(tree.is_cat_split, False)),
+        cat_mask=(None if tree.cat_mask is None
+                  else p_node2(tree.cat_mask)))
 
 
 def grow_tree(
@@ -181,6 +199,7 @@ def grow_tree(
     row_chunk: int = 131072,
     hist_dtype: str = "f32",
     wave_width: int = 1,
+    cat_info=None,
 ) -> Tuple[Tree, jnp.ndarray]:
     """Grow one best-first tree.
 
@@ -210,7 +229,9 @@ def grow_tree(
     splits per histogram pass via the subtraction trick — the large-data
     fast path).
     """
-    if wave_width > 1:
+    if wave_width > 1 and cat_info is None:
+        # (the frontier grower does not implement categorical subset splits
+        # yet; datasets with categoricals use strict growth)
         return grow_tree_frontier(
             bins, stats, feature_mask, ctx, num_leaves, num_bins, max_depth,
             wave_width, ff_bynode=ff_bynode, key=key, axis_name=axis_name,
@@ -251,7 +272,7 @@ def grow_tree(
     # LightGBM convention: max_depth <= 0 means unlimited, so the root
     # (depth 0) is always splittable — if a limit exists it is >= 1.
     root_best = find_best_split(root_hist, ctx, node_feature_mask(0),
-                                jnp.bool_(True))
+                                jnp.bool_(True), cat_info)
 
     def full(val, dtype):
         return jnp.full((capacity,), val, dtype)
@@ -280,6 +301,11 @@ def grow_tree(
         n_nodes=jnp.int32(1),
         n_leaves=jnp.int32(1),
         done=jnp.bool_(False),
+        cand_cat=(None if cat_info is None else
+                  full(False, jnp.bool_).at[0].set(root_best.cat)),
+        cand_catmask=(None if cat_info is None else
+                      jnp.zeros((capacity, num_bins), jnp.bool_)
+                      .at[0].set(root_best.cat_mask)),
     )
 
     bins_i32 = bins.astype(jnp.int32)
@@ -298,7 +324,11 @@ def grow_tree(
 
         # 2. partition rows of the split leaf (gather, no pointer chasing).
         col = jnp.take(bins_i32, feat, axis=1)
-        go_left = col <= thr
+        if cat_info is None:
+            go_left = col <= thr
+        else:
+            go_left = jnp.where(st.cand_cat[leaf],
+                                st.cand_catmask[leaf][col], col <= thr)
         new_rl = jnp.where(
             st.row_leaf == leaf, jnp.where(go_left, nl, nr), st.row_leaf)
         row_leaf = jnp.where(active, new_rl, st.row_leaf)
@@ -314,8 +344,8 @@ def grow_tree(
         depth_ok = (max_depth <= 0) | (child_depth < max_depth)
         child_masks = jnp.stack([node_feature_mask(nl), node_feature_mask(nr)])
         bs: BestSplit = jax.vmap(
-            find_best_split, in_axes=(0, None, 0, None))(
-                hist2, ctx, child_masks, depth_ok)
+            lambda h, m: find_best_split(h, ctx, m, depth_ok, cat_info))(
+                hist2, child_masks)
 
         lg, lh, lc = st.cand_lg[leaf], st.cand_lh[leaf], st.cand_lc[leaf]
         rg, rh, rc = st.cand_rg[leaf], st.cand_rh[leaf], st.cand_rc[leaf]
@@ -358,11 +388,18 @@ def grow_tree(
             n_nodes=st.n_nodes + jnp.where(active, 2, 0).astype(jnp.int32),
             n_leaves=st.n_leaves + jnp.where(active, 1, 0).astype(jnp.int32),
             done=st.done | ~jnp.isfinite(gain),
+            cand_cat=(None if cat_info is None else _write(
+                _write(st.cand_cat, nl, bs.cat[0], active),
+                nr, bs.cat[1], active)),
+            cand_catmask=(None if cat_info is None else _write(
+                _write(st.cand_catmask, nl, bs.cat_mask[0], active),
+                nr, bs.cat_mask[1], active)),
         )
         return new
 
     st = lax.fori_loop(0, num_leaves - 1, body, st)
 
+    internal = (~st.is_leaf) & (st.left >= 0)
     tree = Tree(
         split_feature=st.split_feature,
         split_bin=st.split_bin,
@@ -373,6 +410,9 @@ def grow_tree(
         count=st.count,
         split_gain=st.split_gain,
         num_leaves=st.n_leaves,
+        is_cat_split=(None if cat_info is None
+                      else internal & st.cand_cat),
+        cat_mask=(None if cat_info is None else st.cand_catmask),
     )
     return tree, st.row_leaf
 

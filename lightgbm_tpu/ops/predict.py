@@ -44,7 +44,11 @@ def predict_tree_binned(tree, bins: jnp.ndarray, max_depth_cap: int) -> jnp.ndar
         feat = tree.split_feature[node]            # [n]
         thr = tree.split_bin[node]                 # [n]
         code = jnp.take_along_axis(bins, feat[:, None], axis=1)[:, 0]
-        nxt = jnp.where(code <= thr, tree.left[node], tree.right[node])
+        left = code <= thr
+        if tree.is_cat_split is not None:
+            left = jnp.where(tree.is_cat_split[node],
+                             tree.cat_mask[node, code], left)
+        nxt = jnp.where(left, tree.left[node], tree.right[node])
         node = jnp.where(tree.is_leaf[node], node, nxt)
         return node, None
 
@@ -116,7 +120,11 @@ def predict_forest_binned(
             feat = tree.split_feature[node]
             thr = tree.split_bin[node]
             code = jnp.take_along_axis(bins, feat[:, None], axis=1)[:, 0]
-            nxt = jnp.where(code <= thr, tree.left[node], tree.right[node])
+            left = code <= thr
+            if tree.is_cat_split is not None:
+                left = jnp.where(tree.is_cat_split[node],
+                                 tree.cat_mask[node, code], left)
+            nxt = jnp.where(left, tree.left[node], tree.right[node])
             return jnp.where(tree.is_leaf[node], node, nxt), None
 
         node, _ = lax.scan(step, jnp.zeros(n, jnp.int32), None,

@@ -59,6 +59,21 @@ def leaf_output(sum_g, sum_h, ctx: SplitContext):
     return -threshold_l1(sum_g, ctx.lambda_l1) / (sum_h + ctx.lambda_l2 + 1e-15)
 
 
+class CatInfo(NamedTuple):
+    """Static-per-dataset categorical split configuration.
+
+    ``is_cat`` marks the TRAINING columns (post-EFB) holding categorical
+    codes; the scalars mirror upstream ``cat_smooth`` / ``cat_l2`` /
+    ``max_cat_threshold`` (cat-specific regularization of the k-vs-rest
+    subset search).
+    """
+
+    is_cat: jnp.ndarray        # bool [F]
+    cat_smooth: jnp.ndarray    # f32 []
+    cat_l2: jnp.ndarray        # f32 []
+    max_cat_threshold: int     # static
+
+
 class BestSplit(NamedTuple):
     gain: jnp.ndarray      # f32 [] best gain (NEG_INF if no valid split)
     feature: jnp.ndarray   # i32 []
@@ -69,6 +84,9 @@ class BestSplit(NamedTuple):
     right_g: jnp.ndarray
     right_h: jnp.ndarray
     right_c: jnp.ndarray
+    # categorical subset splits (None when the dataset has no categoricals)
+    cat: jnp.ndarray = None       # bool [] winner is a k-vs-rest cat split
+    cat_mask: jnp.ndarray = None  # bool [B] bins that go LEFT
 
 
 def find_best_split(
@@ -76,6 +94,7 @@ def find_best_split(
     ctx: SplitContext,
     feature_mask: jnp.ndarray,
     depth_ok: jnp.ndarray,
+    cat_info=None,
 ) -> BestSplit:
     """Scan one leaf's histogram for the best (feature, bin) split.
 
@@ -85,6 +104,11 @@ def find_best_split(
       feature_mask: f32/bool ``[F]`` — 1 for usable features this tree
         (feature_fraction sampling; SURVEY.md §2C "Stochasticity").
       depth_ok: bool [] — False disqualifies every split (max_depth cap).
+      cat_info: optional :class:`CatInfo`.  Categorical columns use
+        LightGBM's gradient-ordered k-vs-rest subset search (Fisher 1958
+        trick, upstream ``FindBestThresholdCategorical``): bins sort by
+        grad/(hess + cat_smooth), the usual prefix scan runs in that order,
+        and the winning prefix becomes the left-child category SET.
 
     Returns BestSplit with child statistics so the grower can update node
     state without touching the histogram again.
@@ -111,15 +135,57 @@ def find_best_split(
     gain = jnp.where(valid, gain, NEG_INF)
 
     num_features, num_bins = gain.shape
-    flat_idx = jnp.argmax(gain.reshape(-1))
+
+    if cat_info is None:
+        flat_idx = jnp.argmax(gain.reshape(-1))
+        feat = (flat_idx // num_bins).astype(jnp.int32)
+        bin_idx = (flat_idx % num_bins).astype(jnp.int32)
+        return BestSplit(
+            gain=gain.reshape(-1)[flat_idx], feature=feat, bin=bin_idx,
+            left_g=lg[feat, bin_idx], left_h=lh[feat, bin_idx],
+            left_c=lc[feat, bin_idx], right_g=rg[feat, bin_idx],
+            right_h=rh[feat, bin_idx], right_c=rc[feat, bin_idx])
+
+    is_cat = cat_info.is_cat
+    # Fisher ordering: bins ranked by grad/(hess + cat_smooth); empty bins
+    # push to the end (+inf) so prefixes only accumulate populated
+    # categories and unseen-at-this-node categories fall to the RIGHT child
+    g_, h_, c_ = hist[..., 0], hist[..., 1], hist[..., 2]
+    score = jnp.where(c_ > 0, g_ / (h_ + cat_info.cat_smooth), jnp.inf)
+    order = jnp.argsort(score, axis=1)             # [F, B]
+    hist_s = jnp.take_along_axis(hist, order[..., None], axis=1)
+    cum_s = jnp.cumsum(hist_s, axis=1)
+    slg, slh, slc = cum_s[..., 0], cum_s[..., 1], cum_s[..., 2]
+    srg, srh, src = tg - slg, th - slh, tc - slc
+    ctx_cat = ctx._replace(lambda_l2=ctx.lambda_l2 + cat_info.cat_l2)
+    parent_cat = leaf_objective(tg, th, ctx_cat)
+    gain_c = (leaf_objective(slg, slh, ctx_cat)
+              + leaf_objective(srg, srh, ctx_cat) - parent_cat)
+    pos = jnp.arange(num_bins)[None, :]
+    valid_c = (
+        (slc >= ctx.min_data_in_leaf)
+        & (src >= ctx.min_data_in_leaf)
+        & (slh >= ctx.min_sum_hessian)
+        & (srh >= ctx.min_sum_hessian)
+        & (gain_c > ctx.min_gain_to_split)
+        & (feature_mask[:, None] > 0)
+        & depth_ok
+        & (pos < cat_info.max_cat_threshold)
+    )
+    gain_c = jnp.where(valid_c, gain_c, NEG_INF)
+    # categorical columns ONLY take subset splits; numeric only thresholds
+    gain_all = jnp.where(is_cat[:, None], gain_c, gain)
+
+    flat_idx = jnp.argmax(gain_all.reshape(-1))
     feat = (flat_idx // num_bins).astype(jnp.int32)
     bin_idx = (flat_idx % num_bins).astype(jnp.int32)
-    best_gain = gain.reshape(-1)[flat_idx]
-
+    cat_won = is_cat[feat]
+    order_f = order[feat]                          # [B]
+    inv = jnp.argsort(order_f)                     # rank of each bin
+    cat_mask = cat_won & (inv <= bin_idx)          # bool [B]
+    pick = lambda a, b: jnp.where(cat_won, a[feat, bin_idx], b[feat, bin_idx])
     return BestSplit(
-        gain=best_gain,
-        feature=feat,
-        bin=bin_idx,
-        left_g=lg[feat, bin_idx], left_h=lh[feat, bin_idx], left_c=lc[feat, bin_idx],
-        right_g=rg[feat, bin_idx], right_h=rh[feat, bin_idx], right_c=rc[feat, bin_idx],
-    )
+        gain=gain_all.reshape(-1)[flat_idx], feature=feat, bin=bin_idx,
+        left_g=pick(slg, lg), left_h=pick(slh, lh), left_c=pick(slc, lc),
+        right_g=pick(srg, rg), right_h=pick(srh, rh), right_c=pick(src, rc),
+        cat=cat_won, cat_mask=cat_mask)

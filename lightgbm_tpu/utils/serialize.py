@@ -19,7 +19,7 @@ _FORMAT_VERSION = 1
 
 
 def _tree_to_dict(tree) -> dict:
-    return {
+    d = {
         "split_feature": np.asarray(tree.split_feature).tolist(),
         "split_bin": np.asarray(tree.split_bin).tolist(),
         "left": np.asarray(tree.left).tolist(),
@@ -31,11 +31,34 @@ def _tree_to_dict(tree) -> dict:
         # scalar for binary/regression; [K] list for multiclass rounds
         "num_leaves": np.asarray(tree.num_leaves).tolist(),
     }
+    if tree.is_cat_split is not None:
+        # sparse: only categorical split nodes carry their left-bin sets
+        icb = np.asarray(tree.is_cat_split).reshape(-1)
+        cm = np.asarray(tree.cat_mask)
+        d["num_bins"] = int(cm.shape[-1])
+        cm2 = cm.reshape(-1, cm.shape[-1])
+        d["cat_splits"] = {
+            str(i): np.flatnonzero(cm2[i]).tolist()
+            for i in np.flatnonzero(icb)}
+        d["cat_shape"] = list(np.asarray(tree.is_cat_split).shape)
+    return d
 
 
 def _tree_from_dict(d: dict):
     import jax.numpy as jnp
     from ..models.tree import Tree
+
+    is_cat_split = cat_mask = None
+    if "cat_splits" in d:
+        shape = tuple(d["cat_shape"])
+        b = int(d["num_bins"])
+        icb = np.zeros(int(np.prod(shape)), bool)
+        cm = np.zeros((int(np.prod(shape)), b), bool)
+        for k, bins_left in d["cat_splits"].items():
+            icb[int(k)] = True
+            cm[int(k), np.asarray(bins_left, np.int64)] = True
+        is_cat_split = jnp.asarray(icb.reshape(shape))
+        cat_mask = jnp.asarray(cm.reshape(shape + (b,)))
 
     return Tree(
         split_feature=jnp.asarray(d["split_feature"], jnp.int32),
@@ -47,6 +70,8 @@ def _tree_from_dict(d: dict):
         count=jnp.asarray(d["count"], jnp.float32),
         split_gain=jnp.asarray(d["split_gain"], jnp.float32),
         num_leaves=jnp.asarray(d["num_leaves"], jnp.int32),
+        is_cat_split=is_cat_split,
+        cat_mask=cat_mask,
     )
 
 
@@ -76,6 +101,10 @@ def booster_to_string(booster, num_iteration: Optional[int] = None,
             "nan_bin": mapper.nan_bin.tolist(),
             "n_bins": mapper.n_bins.tolist(),
             "is_categorical": mapper.is_categorical.astype(int).tolist(),
+            "bundler": (None if mapper.bundler is None else {
+                "groups": mapper.bundler.groups,
+                "default_bins": mapper.bundler.default_bins.tolist(),
+            }),
         },
         "trees": [_tree_to_dict(t) for t in booster.trees[start:start + k]],
     }
@@ -132,3 +161,8 @@ def load_booster_into(booster, model_file: Optional[str] = None,
         np.asarray(bm["n_bins"], np.int32),
         np.asarray(bm["is_categorical"], bool),
     )
+    if bm.get("bundler"):
+        from ..dataset import FeatureBundler
+        booster._bin_mapper.bundler = FeatureBundler(
+            bm["bundler"]["groups"], booster._bin_mapper.n_bins,
+            np.asarray(bm["bundler"]["default_bins"], np.int64))

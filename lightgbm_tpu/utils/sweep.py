@@ -171,7 +171,7 @@ def run_grid_search(
             params = dict(base)
             params.update(cfg)
             parsed.append(parse_params(params, warn_unknown=False))
-        if all(fused_cv_eligible(p, None, None) for p in parsed):
+        if all(fused_cv_eligible(p, None, None, train_set) for p in parsed):
             return _run_fused(grid, parsed, train_set, ledger,
                               num_boost_round, nfold,
                               early_stopping_rounds, seed, verbose)
