@@ -597,6 +597,18 @@ class Booster:
         same = (len(cur_m.upper_bounds) == len(prev_m.upper_bounds) and all(
             len(a) == len(b) and np.allclose(a, b)
             for a, b in zip(cur_m.upper_bounds, prev_m.upper_bounds)))
+        # EFB changes the TRAINING column space without touching
+        # upper_bounds — bundling must match too or the ingested trees'
+        # split_feature indices mean different columns
+        cur_b = getattr(cur_m, "bundler", None)
+        prev_b = getattr(prev_m, "bundler", None)
+        if (cur_b is None) != (prev_b is None):
+            same = False
+        elif cur_b is not None and (
+                cur_b.groups != prev_b.groups
+                or not np.array_equal(cur_b.default_bins,
+                                      prev_b.default_bins)):
+            same = False
         if not same:
             raise ValueError(
                 "init_model was trained with different feature binning than "
@@ -815,9 +827,26 @@ class Booster:
         lr = jnp.float32(p.learning_rate)
         add = _tree_pred_fn(self._depth_cap, 1)
 
+        drop_sum = None
+        if k > 0:
+            # ONE stacked forest pass computes the dropped trees' summed raw
+            # values per dataset (not k separate single-tree dispatches)
+            caps = {int(self.trees[t].split_feature.shape[-1])
+                    for t in dropped}
+            cap = max(caps)
+            stack = jax.tree.map(
+                lambda *xs: jnp.stack(xs),
+                *[pad_tree(self.trees[t], cap) for t in dropped])
+
+            def dropped_sum(bins):
+                return predict_forest_binned(
+                    stack, bins, 1.0, 0.0, jnp.int32(k), self._depth_cap)
+
+            drop_sum = dropped_sum(ds.X_binned)
+
         pred = self._pred_train
-        for t in dropped:
-            pred = add(pred, self.trees[t], ds.X_binned, -lr)
+        if k > 0:
+            pred = pred - lr * drop_sum
 
         eff_rows = int(ds.row_mask.shape[0])
         fn = _round_fn(self._obj_key, p.num_leaves, self._num_bins,
@@ -845,18 +874,19 @@ class Booster:
             tree = tree._replace(
                 leaf_value=tree.leaf_value * jnp.float32(new_scale))
             new_pred = pred + (new_pred - pred) * jnp.float32(new_scale)
-            # valid-set deltas from rescaling dropped trees, using the OLD
-            # leaf values (before they are overwritten below)
+            # valid-set deltas from rescaling dropped trees — one stacked
+            # forest pass per valid set, using the OLD leaf values
             for idx, (name, vds, vpred) in enumerate(self._valid):
-                for t in dropped:
-                    vpred = add(vpred, self.trees[t], vds.X_binned,
-                                lr * jnp.float32(drop_scale - 1.0))
-                self._valid[idx] = (name, vds, vpred)
+                vsum = dropped_sum(vds.X_binned)
+                self._valid[idx] = (
+                    name, vds,
+                    vpred + lr * jnp.float32(drop_scale - 1.0) * vsum)
             for t in dropped:
                 self.trees[t] = self.trees[t]._replace(
                     leaf_value=self.trees[t].leaf_value
                     * jnp.float32(drop_scale))
-                new_pred = add(new_pred, self.trees[t], ds.X_binned, lr)
+            # re-add the (now rescaled) dropped trees' contribution
+            new_pred = new_pred + lr * jnp.float32(drop_scale) * drop_sum
 
         self._pred_train = new_pred
         self.trees.append(tree)
@@ -1165,6 +1195,13 @@ class Booster:
         save_booster(self, filename, num_iteration=num_iteration,
                      start_iteration=start_iteration)
         return self
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> Dict[str, Any]:
+        """Nested-dict model dump (LightGBM ``dump_model`` contract)."""
+        from ..utils.serialize import dump_booster_dict
+        return dump_booster_dict(self, num_iteration=num_iteration,
+                                 start_iteration=start_iteration)
 
     def model_to_string(self, num_iteration: Optional[int] = None,
                         start_iteration: int = 0) -> str:

@@ -148,31 +148,43 @@ def find_best_split(
 
     is_cat = cat_info.is_cat
     # Fisher ordering: bins ranked by grad/(hess + cat_smooth); empty bins
-    # push to the end (+inf) so prefixes only accumulate populated
-    # categories and unseen-at-this-node categories fall to the RIGHT child
+    # push to the end (+/-inf) so prefixes only accumulate populated
+    # categories and unseen-at-this-node categories fall to the RIGHT
+    # child.  Upstream scans ASCENDING and DESCENDING (each prefix capped
+    # at max_cat_threshold), which together reach small-subset partitions
+    # on either end of the ordering.
     g_, h_, c_ = hist[..., 0], hist[..., 1], hist[..., 2]
-    score = jnp.where(c_ > 0, g_ / (h_ + cat_info.cat_smooth), jnp.inf)
-    order = jnp.argsort(score, axis=1)             # [F, B]
-    hist_s = jnp.take_along_axis(hist, order[..., None], axis=1)
-    cum_s = jnp.cumsum(hist_s, axis=1)
-    slg, slh, slc = cum_s[..., 0], cum_s[..., 1], cum_s[..., 2]
-    srg, srh, src = tg - slg, th - slh, tc - slc
+    raw_score = g_ / (h_ + cat_info.cat_smooth)
+    pos = jnp.arange(num_bins)[None, :]
     ctx_cat = ctx._replace(lambda_l2=ctx.lambda_l2 + cat_info.cat_l2)
     parent_cat = leaf_objective(tg, th, ctx_cat)
-    gain_c = (leaf_objective(slg, slh, ctx_cat)
-              + leaf_objective(srg, srh, ctx_cat) - parent_cat)
-    pos = jnp.arange(num_bins)[None, :]
-    valid_c = (
-        (slc >= ctx.min_data_in_leaf)
-        & (src >= ctx.min_data_in_leaf)
-        & (slh >= ctx.min_sum_hessian)
-        & (srh >= ctx.min_sum_hessian)
-        & (gain_c > ctx.min_gain_to_split)
-        & (feature_mask[:, None] > 0)
-        & depth_ok
-        & (pos < cat_info.max_cat_threshold)
-    )
-    gain_c = jnp.where(valid_c, gain_c, NEG_INF)
+
+    def scan_direction(order):
+        hist_s = jnp.take_along_axis(hist, order[..., None], axis=1)
+        cum_s = jnp.cumsum(hist_s, axis=1)
+        slg, slh, slc = cum_s[..., 0], cum_s[..., 1], cum_s[..., 2]
+        srg, srh, src = tg - slg, th - slh, tc - slc
+        gain_c = (leaf_objective(slg, slh, ctx_cat)
+                  + leaf_objective(srg, srh, ctx_cat) - parent_cat)
+        valid_c = (
+            (slc >= ctx.min_data_in_leaf)
+            & (src >= ctx.min_data_in_leaf)
+            & (slh >= ctx.min_sum_hessian)
+            & (srh >= ctx.min_sum_hessian)
+            & (gain_c > ctx.min_gain_to_split)
+            & (feature_mask[:, None] > 0)
+            & depth_ok
+            & (pos < cat_info.max_cat_threshold)
+        )
+        return jnp.where(valid_c, gain_c, NEG_INF), (slg, slh, slc, srg,
+                                                     srh, src)
+
+    order_asc = jnp.argsort(jnp.where(c_ > 0, raw_score, jnp.inf), axis=1)
+    order_desc = jnp.argsort(jnp.where(c_ > 0, -raw_score, jnp.inf), axis=1)
+    gain_a, stats_a = scan_direction(order_asc)
+    gain_d, stats_d = scan_direction(order_desc)
+    use_desc = gain_d > gain_a
+    gain_c = jnp.maximum(gain_a, gain_d)
     # categorical columns ONLY take subset splits; numeric only thresholds
     gain_all = jnp.where(is_cat[:, None], gain_c, gain)
 
@@ -180,12 +192,21 @@ def find_best_split(
     feat = (flat_idx // num_bins).astype(jnp.int32)
     bin_idx = (flat_idx % num_bins).astype(jnp.int32)
     cat_won = is_cat[feat]
-    order_f = order[feat]                          # [B]
+    desc_won = use_desc[feat, bin_idx]
+    order_f = jnp.where(desc_won, order_desc[feat], order_asc[feat])  # [B]
     inv = jnp.argsort(order_f)                     # rank of each bin
     cat_mask = cat_won & (inv <= bin_idx)          # bool [B]
-    pick = lambda a, b: jnp.where(cat_won, a[feat, bin_idx], b[feat, bin_idx])
+
+    def pick(ia, ib, plain):
+        cat_val = jnp.where(desc_won, ib[feat, bin_idx], ia[feat, bin_idx])
+        return jnp.where(cat_won, cat_val, plain[feat, bin_idx])
+
     return BestSplit(
         gain=gain_all.reshape(-1)[flat_idx], feature=feat, bin=bin_idx,
-        left_g=pick(slg, lg), left_h=pick(slh, lh), left_c=pick(slc, lc),
-        right_g=pick(srg, rg), right_h=pick(srh, rh), right_c=pick(src, rc),
+        left_g=pick(stats_a[0], stats_d[0], lg),
+        left_h=pick(stats_a[1], stats_d[1], lh),
+        left_c=pick(stats_a[2], stats_d[2], lc),
+        right_g=pick(stats_a[3], stats_d[3], rg),
+        right_h=pick(stats_a[4], stats_d[4], rh),
+        right_c=pick(stats_a[5], stats_d[5], rc),
         cat=cat_won, cat_mask=cat_mask)

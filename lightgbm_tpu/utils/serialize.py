@@ -120,6 +120,114 @@ def save_booster(booster, filename: str,
                                   start_iteration=start_iteration))
 
 
+def dump_booster_dict(booster, num_iteration: Optional[int] = None,
+                      start_iteration: int = 0) -> dict:
+    """LightGBM ``Booster.dump_model()`` equivalent: a nested-dict view of
+    the model with RAW-VALUE thresholds (bin bounds resolved through the
+    training bin mapper), traversable without any lightgbm_tpu code.
+
+    Categorical subset splits dump ``decision_type: '=='`` with the LEFT
+    category bin set; numeric splits dump ``decision_type: '<='`` with the
+    raw threshold.  When EFB is active, ``split_feature`` is mapped back to
+    the ORIGINAL feature space (matching ``feature_names``); thresholds on
+    multi-feature bundle columns stay in bundled-bin space and are marked
+    with ``"bundled_bin_threshold": true``.
+    """
+    start = max(int(start_iteration), 0)
+    k = (len(booster.trees) if num_iteration is None or num_iteration <= 0
+         else min(int(num_iteration), len(booster.trees) - start))
+    mapper = booster._bin_mapper_for_predict()
+    bundler = getattr(mapper, "bundler", None)
+    multi_groups = (set() if bundler is None else
+                    {c for c, g in enumerate(bundler.groups) if len(g) > 1})
+
+    def node_dict(tree, i: int, split_index: int):
+        sf = np.asarray(tree.split_feature)
+        sb = np.asarray(tree.split_bin)
+        left = np.asarray(tree.left)
+        right = np.asarray(tree.right)
+        is_leaf = np.asarray(tree.is_leaf)
+        vals = np.asarray(tree.leaf_value, np.float64)
+        gains = np.asarray(tree.split_gain, np.float64)
+        counts = np.asarray(tree.count, np.float64)
+        icb = (np.asarray(tree.is_cat_split)
+               if tree.is_cat_split is not None else None)
+        cm = (np.asarray(tree.cat_mask)
+              if tree.cat_mask is not None else None)
+
+        def rec(node: int) -> dict:
+            if is_leaf[node] or left[node] < 0:
+                return {"leaf_index": int(node),
+                        "leaf_value": float(vals[node]),
+                        "leaf_count": int(counts[node])}
+            col = int(sf[node])
+            thr_bin = int(sb[node])
+            if bundler is not None:
+                feat = int(bundler.split_to_original(
+                    np.array([col]), np.array([thr_bin]))[0])
+            else:
+                feat = col
+            out = {
+                "split_index": int(node),
+                "split_feature": feat,
+                "split_gain": float(gains[node]),
+                "internal_count": int(counts[node]),
+                "default_left": True,
+                "left_child": rec(int(left[node])),
+                "right_child": rec(int(right[node])),
+            }
+            if icb is not None and icb[node]:
+                out["decision_type"] = "=="
+                out["threshold"] = [int(b) for b in np.flatnonzero(cm[node])]
+            elif col in multi_groups:
+                # threshold lives on the merged EFB bin axis; raw-value
+                # resolution is not well-defined across members
+                out["decision_type"] = "<="
+                out["threshold"] = thr_bin
+                out["bundled_bin_threshold"] = True
+            else:
+                out["decision_type"] = "<="
+                out["threshold"] = float(
+                    mapper.bin_upper_bound(feat, thr_bin))
+            return out
+
+        import sys
+        old_limit = sys.getrecursionlimit()
+        sys.setrecursionlimit(max(old_limit, 2 * len(sf) + 100))
+        try:
+            return rec(0)
+        finally:
+            sys.setrecursionlimit(old_limit)
+
+    trees_info = []
+    idx = start * booster.num_model_per_iteration()
+    for i, tree in enumerate(booster.trees[start:start + k]):
+        ndim = np.asarray(tree.split_feature).ndim
+        per_round = ([tree] if ndim == 1 else [
+            type(tree)(*[None if f is None else
+                         (np.asarray(f)[c] if np.asarray(f).ndim else f)
+                         for f in tree])
+            for c in range(np.asarray(tree.split_feature).shape[0])])
+        for t in per_round:
+            trees_info.append({
+                "tree_index": idx,
+                "num_leaves": int(np.asarray(t.num_leaves).max()),
+                "shrinkage": float(booster.params.learning_rate),
+                "tree_structure": node_dict(t, idx, 0),
+            })
+            idx += 1
+    return {
+        "name": "tree",
+        "version": "lightgbm_tpu",
+        "objective": booster.params.objective,
+        "num_class": booster.num_model_per_iteration(),
+        "num_tree_per_iteration": booster.num_model_per_iteration(),
+        "max_feature_idx": booster.num_feature() - 1,
+        "feature_names": booster.feature_name(),
+        "tree_info": trees_info,
+    }
+
+
 def load_booster_into(booster, model_file: Optional[str] = None,
                       model_str: Optional[str] = None) -> None:
     """Populate a bare Booster instance from a saved model."""

@@ -130,3 +130,39 @@ def test_nan_at_predict_maps_to_zero_bin(reg_data):
     Xn = Xq.copy(); Xn[:, 0] = np.nan
     np.testing.assert_allclose(b.predict(Xn), b.predict(Xz),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_dump_model_structure(reg_data):
+    """dump_model(): traversable nested dict with raw-value thresholds."""
+    X, y = reg_data
+    params = {"objective": "regression", "num_leaves": 7, "verbosity": -1}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=3)
+    d = b.dump_model()
+    assert d["num_class"] == 1
+    assert len(d["tree_info"]) == 3
+    assert d["max_feature_idx"] == X.shape[1] - 1
+
+    def walk(node, depth=0):
+        if "leaf_value" in node:
+            return 1
+        assert node["decision_type"] == "<="
+        assert isinstance(node["threshold"], float)
+        return walk(node["left_child"]) + walk(node["right_child"])
+
+    leaves = walk(d["tree_info"][0]["tree_structure"])
+    assert leaves == d["tree_info"][0]["num_leaves"]
+    # manual traversal of the dumped dict must reproduce predict()
+    def traverse(node, row):
+        while "leaf_value" not in node:
+            node = (node["left_child"]
+                    if row[node["split_feature"]] <= node["threshold"]
+                    else node["right_child"])
+        return node["leaf_value"]
+
+    lr = 0.1
+    manual = np.array([
+        b.init_score_ + lr * sum(
+            traverse(t["tree_structure"], X[i]) for t in d["tree_info"])
+        for i in range(20)])
+    np.testing.assert_allclose(manual, b.predict(X[:20]), rtol=1e-4,
+                               atol=1e-5)
