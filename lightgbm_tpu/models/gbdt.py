@@ -177,6 +177,46 @@ def _rebuild_objective(key: tuple) -> Objective:
     return obj
 
 
+def _goss_compact_round(bins, y, w, bag, pred, fmask, hyper: HyperScalars,
+                        key, g, h, goss_k, num_leaves, num_bins, hist_impl,
+                        row_chunk, hist_dtype, wave_width, cat_info,
+                        renew_alpha):
+    """One compacted GOSS round (shared by the per-round and scanned paths
+    — the two MUST stay in RNG lockstep for fused == host training).
+
+    Unlike CPU LightGBM (where skipping rows is free), a TPU histogram pass
+    costs the same for masked rows as for live ones — so the sampled subset
+    is GATHERED into a dense [k_top + k_other, F] matrix and the tree grown
+    on that, cutting histogram cost by ~(top_rate + other_rate).  Train
+    scores for ALL rows then come from one traversal pass."""
+    k_top, k_other = goss_k
+    n = bins.shape[0]
+    g_abs = jnp.where(bag > 0, jnp.abs(g), -1.0)
+    _, top_idx = jax.lax.top_k(g_abs, k_top)
+    is_top = jnp.zeros(n, bool).at[top_idx].set(True)
+    rest = (bag > 0) & ~is_top
+    u = jax.random.uniform(jax.random.fold_in(key, 0x7FFFFFFF), (n,))
+    _, other_idx = jax.lax.top_k(jnp.where(rest, u, -1.0), k_other)
+    idx = jnp.concatenate([top_idx, other_idx])         # [k]
+    amp = (1.0 - hyper.top_rate) / jnp.maximum(hyper.other_rate, 1e-12)
+    wt = jnp.concatenate([jnp.ones(k_top, jnp.float32),
+                          jnp.full(k_other, 1.0, jnp.float32) * amp])
+    bins_c = jnp.take(bins, idx, axis=0)
+    stats = jnp.stack([g[idx] * wt, h[idx] * wt,
+                       jnp.ones(k_top + k_other, jnp.float32)], axis=-1)
+    tree, rl_c = grow_tree(
+        bins_c, stats, fmask, hyper.ctx(), num_leaves, num_bins,
+        hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode, key=key,
+        hist_impl=hist_impl, row_chunk=row_chunk, hist_dtype=hist_dtype,
+        wave_width=wave_width, cat_info=cat_info)
+    if renew_alpha is not None:
+        tree = renew_leaf_values(tree, rl_c, y[idx] - pred[idx],
+                                 w[idx] * wt, renew_alpha)
+    new_pred = pred + hyper.learning_rate * predict_tree_binned(
+        tree, bins, num_leaves)
+    return tree, new_pred
+
+
 @functools.lru_cache(maxsize=None)
 def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
               hist_impl: str, row_chunk: int, is_rf: bool,
@@ -206,7 +246,7 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                         hyper: HyperScalars, key):
             g, h = obj.grad_hess(pred, y, w)          # [n, K]
             if is_goss:
-                bag = goss_bag(jax.random.fold_in(key, -1), g, bag, hyper)
+                bag = goss_bag(jax.random.fold_in(key, 0x7FFFFFFF), g, bag, hyper)
 
             def grow_one(gc, hc, kc):
                 stats = jnp.stack([gc * bag, hc * bag,
@@ -230,47 +270,16 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
         return round_fn_mc
 
     if is_goss:  # single-class: compacted GOSS (mc handled above, masked)
-        k_top, k_other = goss_k
 
         @jax.jit
         def round_fn_goss(bins, y, w, bag, pred, feature_mask,
                           hyper: HyperScalars, key):
-            """Compacted GOSS round: unlike CPU LightGBM (where skipping
-            rows is free), a TPU histogram pass costs the same for masked
-            rows as for live ones — so the sampled subset is GATHERED into
-            a dense [k_top + k_other, F] matrix and the tree grown on that,
-            cutting histogram cost by ~(top_rate + other_rate).  Train
-            scores for ALL rows then come from one traversal pass."""
-            n = bins.shape[0]
             g, h = obj.grad_hess(pred, y, w)
-            g_abs = jnp.where(bag > 0, jnp.abs(g), -1.0)
-            _, top_idx = jax.lax.top_k(g_abs, k_top)
-            is_top = jnp.zeros(n, bool).at[top_idx].set(True)
-            rest = (bag > 0) & ~is_top
-            u = jax.random.uniform(jax.random.fold_in(key, -1), (n,))
-            _, other_idx = jax.lax.top_k(jnp.where(rest, u, -1.0), k_other)
-            idx = jnp.concatenate([top_idx, other_idx])         # [k]
-            amp = ((1.0 - hyper.top_rate)
-                   / jnp.maximum(hyper.other_rate, 1e-12))
-            wt = jnp.concatenate([jnp.ones(k_top, jnp.float32),
-                                  jnp.full(k_other, 1.0, jnp.float32) * amp])
-            bins_c = jnp.take(bins, idx, axis=0)
-            stats = jnp.stack([g[idx] * wt, h[idx] * wt,
-                               jnp.ones(k_top + k_other, jnp.float32)],
-                              axis=-1)
-            tree, rl_c = grow_tree(
-                bins_c, stats, feature_mask, hyper.ctx(), num_leaves,
-                num_bins, hyper.max_depth,
-                ff_bynode=hyper.feature_fraction_bynode, key=key,
-                hist_impl=hist_impl, row_chunk=row_chunk,
-                hist_dtype=hist_dtype, wave_width=wave_width,
-                cat_info=_build_cat_info(cat_key, bins.shape[1]))
-            if renew_alpha is not None:
-                tree = renew_leaf_values(
-                    tree, rl_c, y[idx] - pred[idx], w[idx] * wt, renew_alpha)
-            new_pred = pred + hyper.learning_rate * predict_tree_binned(
-                tree, bins, num_leaves)
-            return tree, new_pred
+            return _goss_compact_round(
+                bins, y, w, bag, pred, feature_mask, hyper, key, g, h,
+                goss_k, num_leaves, num_bins, hist_impl, row_chunk,
+                hist_dtype, wave_width,
+                _build_cat_info(cat_key, bins.shape[1]), renew_alpha)
 
         return round_fn_goss
 
@@ -301,7 +310,8 @@ def _multi_round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                     hist_impl: str, row_chunk: int, is_rf: bool,
                     hist_dtype: str, wave_width: int, n_rounds: int,
                     bagging_freq: int, use_ff: bool,
-                    cat_key: Optional[tuple] = None):
+                    cat_key: Optional[tuple] = None,
+                    goss_k: Optional[Tuple[int, int]] = None):
     """``n_rounds`` boosting rounds as ONE device program (`lax.scan`).
 
     The host round loop pays a dispatch round-trip per boosting round —
@@ -339,16 +349,24 @@ def _multi_round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                     jax.random.fold_in(ff_key, i), ff, num_features)
             else:
                 fmask = jnp.ones(num_features, jnp.float32)
+            rkey = jax.random.fold_in(round_key, i)
+            cat_info = _build_cat_info(cat_key, bins.shape[1])
             g, h = obj.grad_hess(pred, y, w)
+            if goss_k is not None:
+                tree, new_pred = _goss_compact_round(
+                    bins, y, w, bag, pred, fmask, hyper, rkey, g, h,
+                    goss_k, num_leaves, num_bins, hist_impl, row_chunk,
+                    hist_dtype, wave_width, cat_info, renew_alpha)
+                return (new_pred, bag), tree
             stats = jnp.stack(
                 [g * bag, h * bag, (bag > 0).astype(jnp.float32)], axis=-1)
             tree, row_leaf = grow_tree(
                 bins, stats, fmask, hyper.ctx(), num_leaves, num_bins,
                 hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
-                key=jax.random.fold_in(round_key, i), hist_impl=hist_impl,
+                key=rkey, hist_impl=hist_impl,
                 row_chunk=row_chunk, hist_dtype=hist_dtype,
                 wave_width=wave_width,
-                cat_info=_build_cat_info(cat_key, bins.shape[1]))
+                cat_info=cat_info)
             if renew_alpha is not None:
                 tree = renew_leaf_values(tree, row_leaf, y - pred, w * bag,
                                          renew_alpha)
@@ -512,7 +530,10 @@ class Booster:
              float(p.cat_l2), int(p.max_cat_threshold))
             if len(cats) else None)
         self._dp_mesh = None
-        if p.tree_learner in ("data", "feature", "voting"):
+        self._fp_mesh = None
+        if p.tree_learner == "feature":
+            self._maybe_setup_fp()
+        elif p.tree_learner in ("data", "voting"):
             self._maybe_setup_dp()
 
     def _maybe_setup_dp(self) -> None:
@@ -555,6 +576,45 @@ class Booster:
          self._bag) = shard_rows(
             self._dp_mesh, ds.X_binned, ds.y, self._w_eff,
             self._pred_train, self._bag)
+
+    def _maybe_setup_fp(self) -> None:
+        """Shard the FEATURE axis over the local mesh (LightGBM
+        ``tree_learner=feature`` — per-shard histograms over a column
+        slice, split exchange via all_gather; parallel.feature_parallel).
+        Falls back to data-parallel-style serial training when the
+        configuration needs capabilities the fp step does not trace."""
+        import warnings
+
+        p = self.params
+        if (self._num_class > 1 or p.boosting in ("goss", "dart")
+                or getattr(self.obj, "needs_group", False)
+                or getattr(self.obj, "renew_alpha", None) is not None
+                or self._cat_key is not None
+                or p.feature_fraction_bynode < 1.0):
+            warnings.warn(
+                "tree_learner='feature' currently supports single-output "
+                "non-ranking, non-categorical gbdt/rf without per-node "
+                "feature sampling (bynode would sample per SHARD and "
+                "diverge from serial); training serially", stacklevel=3)
+            return
+        n_dev = len(jax.devices())
+        if n_dev <= 1:
+            warnings.warn(
+                "tree_learner='feature' requested but only one device is "
+                "visible; training serially", stacklevel=3)
+            return
+        from ..parallel.feature_parallel import (
+            make_feature_mesh, pad_features, shard_features)
+
+        ds = self.train_set
+        codes = np.asarray(ds.X_binned)
+        padded = pad_features(codes, n_dev)
+        base_mask = np.zeros(padded.shape[1], np.float32)
+        base_mask[: codes.shape[1]] = 1.0
+        self._fp_mesh = make_feature_mesh(n_dev)
+        self._fp_bins, _ = shard_features(
+            self._fp_mesh, jnp.asarray(padded), jnp.asarray(base_mask))
+        self._fp_width = padded.shape[1]
 
     # -- continuation ----------------------------------------------------
     @property
@@ -695,7 +755,22 @@ class Booster:
             if self._num_class == 1:  # mc uses the masked (non-compacted) path
                 eff_rows = goss_k[0] + goss_k[1]
         round_key = jax.random.fold_in(self._key, i)
-        if getattr(self, "_dp_mesh", None) is not None:
+        if getattr(self, "_fp_mesh", None) is not None:
+            from ..parallel.feature_parallel import make_fp_train_step
+
+            fn = make_fp_train_step(
+                self._fp_mesh, self._obj_key, p.num_leaves, self._num_bins,
+                p.extra.get("hist_impl", "auto"),
+                int(p.extra.get("row_chunk", 131072)), p.boosting == "rf",
+                resolve_hist_dtype(p, eff_rows))
+            pad_cols = self._fp_width - int(fmask.shape[0])
+            fmask_p = jnp.concatenate(
+                [fmask, jnp.zeros(pad_cols, jnp.float32)]) \
+                if pad_cols else fmask
+            tree, new_pred = fn(self._fp_bins, ds.y, self._w_eff, self._bag,
+                                self._pred_train, fmask_p, self._hyper,
+                                round_key)
+        elif getattr(self, "_dp_mesh", None) is not None:
             from ..parallel.data_parallel import make_dp_train_step
 
             fn = make_dp_train_step(
@@ -738,15 +813,16 @@ class Booster:
         p = self.params
         return (self._num_class == 1
                 and getattr(self, "_dp_mesh", None) is None
-                and p.boosting in ("gbdt", "rf")
+                and getattr(self, "_fp_mesh", None) is None
+                and p.boosting in ("gbdt", "rf", "goss")
                 and not self._valid)
 
     def update_many(self, k: int) -> None:
         """Run ``k`` boosting rounds fused into scanned device programs.
 
         Falls back to per-round update() when the configuration needs
-        host-side work between rounds (valid-set eval, multiclass, DP mesh,
-        GOSS' static-k compaction path).  Segments of at most
+        host-side work between rounds (valid-set eval, multiclass,
+        DP/FP mesh, DART's dropout bookkeeping).  Segments of at most
         ``fused_segment_rounds`` (default 25) bound per-dispatch runtime —
         one very long device execution can trip the TPU runtime watchdog —
         and keep the compile cache small (one program per segment length).
@@ -771,6 +847,11 @@ class Booster:
         bag_key = jax.random.PRNGKey(p.bagging_seed + p.seed)
         ff_key = jax.random.PRNGKey(p.feature_fraction_seed + p.seed)
         eff_rows = int(ds.row_mask.shape[0])
+        goss_k = None
+        if p.boosting == "goss":
+            goss_k = (int(p.top_rate * ds.num_data_),
+                      int(p.other_rate * ds.num_data_))
+            eff_rows = goss_k[0] + goss_k[1]
         while k > 0:
             n_rounds = min(k, seg)
             fn = _multi_round_fn(
@@ -780,7 +861,7 @@ class Booster:
                 resolve_hist_dtype(p, eff_rows),
                 resolve_wave_width(p, eff_rows), n_rounds,
                 p.bagging_freq if use_bagging else 0, use_ff,
-                self._cat_key)
+                self._cat_key, goss_k)
             pred, bag, trees = fn(
                 ds.X_binned, ds.y, self._w_eff, self._bag, self._pred_train,
                 self._hyper, self._key, bag_key, ff_key, ds.row_mask,

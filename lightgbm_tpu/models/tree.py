@@ -103,6 +103,34 @@ def _write(arr, idx, val, active):
     return arr.at[idx].set(jnp.where(active, val, arr[idx]))
 
 
+def _fp_reduce_best(bs: BestSplit, axis_name: str,
+                    f_local: int) -> BestSplit:
+    """Feature-parallel combine: each shard found the best split over its
+    OWN feature slice; all-gather the per-shard winners, take the global
+    argmax, and globalize the winning feature index (upstream
+    FeatureParallelTreeLearner's split exchange — one tiny allgather
+    instead of allreducing full histograms)."""
+    shard = lax.axis_index(axis_name)
+    globalized = bs._replace(
+        feature=bs.feature + shard * f_local)
+    stacked = jax.tree.map(
+        lambda x: lax.all_gather(x, axis_name), globalized)  # [D, ...]
+    win = jnp.argmax(stacked.gain)
+    return jax.tree.map(lambda x: x[win], stacked)
+
+
+def _fp_column(bins_local: jnp.ndarray, feat_global, axis_name: str,
+               f_local: int) -> jnp.ndarray:
+    """Fetch the GLOBAL feature column under feature sharding: only the
+    owning shard has it, so it contributes the codes and a psum broadcasts
+    them (the [n] bitmap exchange of upstream's feature-parallel split)."""
+    shard = lax.axis_index(axis_name)
+    local_idx = feat_global - shard * f_local
+    mine = (local_idx >= 0) & (local_idx < f_local)
+    col = jnp.take(bins_local, jnp.clip(local_idx, 0, f_local - 1), axis=1)
+    return lax.psum(jnp.where(mine, col, 0), axis_name)
+
+
 def renew_leaf_values(tree: Tree, row_leaf: jnp.ndarray, residual: jnp.ndarray,
                       weight: jnp.ndarray, alpha) -> Tree:
     """Refit leaf values as weighted alpha-quantiles of the residuals.
@@ -200,6 +228,7 @@ def grow_tree(
     hist_dtype: str = "f32",
     wave_width: int = 1,
     cat_info=None,
+    fp_axis: Optional[str] = None,
 ) -> Tuple[Tree, jnp.ndarray]:
     """Grow one best-first tree.
 
@@ -229,13 +258,13 @@ def grow_tree(
     splits per histogram pass via the subtraction trick — the large-data
     fast path).
     """
-    if wave_width > 1 and cat_info is None:
-        # (the frontier grower does not implement categorical subset splits
-        # yet; datasets with categoricals use strict growth)
+    if wave_width > 1 and fp_axis is None:
+        # (the frontier grower runs data-parallel but not feature-parallel)
         return grow_tree_frontier(
             bins, stats, feature_mask, ctx, num_leaves, num_bins, max_depth,
             wave_width, ff_bynode=ff_bynode, key=key, axis_name=axis_name,
-            hist_impl=hist_impl, row_chunk=row_chunk, hist_dtype=hist_dtype)
+            hist_impl=hist_impl, row_chunk=row_chunk, hist_dtype=hist_dtype,
+            cat_info=cat_info)
     n, num_features = bins.shape
     capacity = 2 * num_leaves - 1
     max_depth = jnp.asarray(max_depth, jnp.int32)
@@ -273,6 +302,8 @@ def grow_tree(
     # (depth 0) is always splittable — if a limit exists it is >= 1.
     root_best = find_best_split(root_hist, ctx, node_feature_mask(0),
                                 jnp.bool_(True), cat_info)
+    if fp_axis is not None:
+        root_best = _fp_reduce_best(root_best, fp_axis, num_features)
 
     def full(val, dtype):
         return jnp.full((capacity,), val, dtype)
@@ -323,7 +354,10 @@ def grow_tree(
         thr = st.cand_bin[leaf]
 
         # 2. partition rows of the split leaf (gather, no pointer chasing).
-        col = jnp.take(bins_i32, feat, axis=1)
+        if fp_axis is not None:
+            col = _fp_column(bins_i32, feat, fp_axis, num_features)
+        else:
+            col = jnp.take(bins_i32, feat, axis=1)
         if cat_info is None:
             go_left = col <= thr
         else:
@@ -346,6 +380,9 @@ def grow_tree(
         bs: BestSplit = jax.vmap(
             lambda h, m: find_best_split(h, ctx, m, depth_ok, cat_info))(
                 hist2, child_masks)
+        if fp_axis is not None:
+            bs = jax.vmap(
+                lambda b: _fp_reduce_best(b, fp_axis, num_features))(bs)
 
         lg, lh, lc = st.cand_lg[leaf], st.cand_lh[leaf], st.cand_lc[leaf]
         rg, rh, rc = st.cand_rg[leaf], st.cand_rh[leaf], st.cand_rc[leaf]
@@ -456,6 +493,9 @@ class _WaveState(NamedTuple):
     row_leaf: jnp.ndarray
     n_nodes: jnp.ndarray
     n_leaves: jnp.ndarray
+    # categorical candidate splits (None when the dataset has none)
+    cand_cat: Optional[jnp.ndarray] = None      # bool[M]
+    cand_catmask: Optional[jnp.ndarray] = None  # bool[M, B]
 
 
 def grow_tree_frontier(
@@ -473,6 +513,7 @@ def grow_tree_frontier(
     hist_impl: str = "auto",
     row_chunk: int = 131072,
     hist_dtype: str = "f32",
+    cat_info=None,
 ) -> Tuple[Tree, jnp.ndarray]:
     """Best-first growth in WAVES: up to ``wave_width`` splits per data pass.
 
@@ -531,7 +572,7 @@ def grow_tree_frontier(
     root_hist = hist_fn(jnp.zeros(n, jnp.int32), 1)[0]          # [F, B, 3]
     root_tot = jnp.sum(root_hist[0], axis=0)                     # (g, h, c)
     root_best = find_best_split(root_hist, ctx, node_feature_mask(0),
-                                jnp.bool_(True))
+                                jnp.bool_(True), cat_info)
 
     def full(val, dtype):
         return jnp.full((capacity,), val, dtype)
@@ -562,6 +603,11 @@ def grow_tree_frontier(
         row_leaf=jnp.zeros(n, jnp.int32),
         n_nodes=jnp.int32(1),
         n_leaves=jnp.int32(1),
+        cand_cat=(None if cat_info is None else
+                  full(False, jnp.bool_).at[0].set(root_best.cat)),
+        cand_catmask=(None if cat_info is None else
+                      jnp.zeros((capacity, num_bins), jnp.bool_)
+                      .at[0].set(root_best.cat_mask)),
     )
 
     bins_i32 = bins.astype(jnp.int32)
@@ -600,7 +646,12 @@ def grow_tree_frontier(
         feat_r = st.cand_feat[p]
         thr_r = st.cand_bin[p]
         v = jnp.take_along_axis(bins_i32, feat_r[:, None], axis=1)[:, 0]
-        child = jnp.where(v <= thr_r, nl_of[p], nr_of[p])
+        if cat_info is None:
+            go_left = v <= thr_r
+        else:
+            go_left = jnp.where(st.cand_cat[p], st.cand_catmask[p, v],
+                                v <= thr_r)
+        child = jnp.where(go_left, nl_of[p], nr_of[p])
         row_leaf = jnp.where(psel, child, p)
 
         # 3. one histogram pass over the SMALLER child of every split.
@@ -638,8 +689,8 @@ def grow_tree_frontier(
         depth_ok = (max_depth <= 0) | (child_depth < max_depth)
         child_masks = jax.vmap(node_feature_mask)(child_nodes)
         bs: BestSplit = jax.vmap(
-            find_best_split, in_axes=(0, None, 0, 0))(
-                child_hists, ctx, child_masks, depth_ok)
+            lambda h, m, d: find_best_split(h, ctx, m, d, cat_info))(
+                child_hists, child_masks, depth_ok)
         active_2 = jnp.concatenate([active_r, active_r])
 
         # 6. commit: parents become internal, children become leaves.
@@ -683,10 +734,15 @@ def grow_tree_frontier(
             row_leaf=row_leaf,
             n_nodes=st.n_nodes + 2 * s,
             n_leaves=st.n_leaves + s,
+            cand_cat=(None if cat_info is None else _scatter(
+                st.cand_cat, child_nodes, bs.cat, active_2)),
+            cand_catmask=(None if cat_info is None else _scatter(
+                st.cand_catmask, child_nodes, bs.cat_mask, active_2)),
         )
 
     st = lax.while_loop(cond, body, st)
 
+    internal = (~st.is_leaf) & (st.left >= 0)
     tree = Tree(
         split_feature=st.split_feature,
         split_bin=st.split_bin,
@@ -697,6 +753,9 @@ def grow_tree_frontier(
         count=st.count,
         split_gain=st.split_gain,
         num_leaves=st.n_leaves,
+        is_cat_split=(None if cat_info is None
+                      else internal & st.cand_cat),
+        cat_mask=(None if cat_info is None else st.cand_catmask),
     )
     return tree, st.row_leaf
 

@@ -1,0 +1,100 @@
+"""Feature-parallel GBDT training over a device mesh.
+
+TPU-native replacement for LightGBM's ``tree_learner=feature`` (upstream
+``FeatureParallelTreeLearner`` + ``network/`` split exchange — SURVEY.md §2C
+"feature-parallel" row): when the histogram tensor, not the row count, is the
+memory/compute bottleneck (wide post-EFB data, huge ``max_bin``), shard the
+FEATURE axis instead of rows:
+
+  * every device holds ALL rows but only its slice of feature columns;
+  * each shard builds histograms and scans splits for its own features only
+    — per-device histogram work and memory drop by the shard count with NO
+    histogram allreduce at all;
+  * the per-shard best splits are combined with one tiny ``all_gather`` +
+    argmax (models.tree._fp_reduce_best), and the winning shard broadcasts
+    the split column with one ``psum`` (models.tree._fp_column) — the [n]
+    "split bitmap" exchange of the upstream design;
+  * the grown tree is replicated by construction.
+
+Contrast with ``data_parallel``: rows sharded, full histograms psum-merged.
+The two compose in principle (2-D mesh) but are exposed separately, matching
+upstream's tree_learner options.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.gbdt import HyperScalars, _rebuild_objective
+from ..models.tree import grow_tree
+
+FEATURE_AXIS = "feature"
+
+
+def make_feature_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D feature-sharding mesh (same device fallback logic as
+    data_parallel.make_mesh)."""
+    from .data_parallel import make_mesh
+
+    return make_mesh(n_devices, devices, axis_name=FEATURE_AXIS)
+
+
+def pad_features(codes: np.ndarray, n_shards: int) -> np.ndarray:
+    """Pad the feature axis to a shard multiple with constant-zero columns
+    (masked out of every split scan by the feature mask)."""
+    f = codes.shape[1]
+    f_pad = -(-f // n_shards) * n_shards
+    if f_pad == f:
+        return codes
+    return np.concatenate(
+        [codes, np.zeros((codes.shape[0], f_pad - f), codes.dtype)], axis=1)
+
+
+def shard_features(mesh: Mesh, bins, fmask):
+    """Place [n, F] bins and [F] masks feature-sharded on the mesh."""
+    col_sharding = NamedSharding(mesh, P(None, FEATURE_AXIS))
+    vec_sharding = NamedSharding(mesh, P(FEATURE_AXIS))
+    return (jax.device_put(bins, col_sharding),
+            jax.device_put(fmask, vec_sharding))
+
+
+@functools.lru_cache(maxsize=None)
+def make_fp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
+                       num_bins: int, hist_impl: str = "auto",
+                       row_chunk: int = 131072, is_rf: bool = False,
+                       hist_dtype: str = "f32"):
+    """Build the jitted feature-parallel round step for a mesh.
+
+    step(bins_fsharded, y, w, bag, pred, fmask_fsharded, hyper, key) ->
+    (tree [replicated], new_pred [replicated]).
+    """
+    obj = _rebuild_objective(obj_key)
+
+    def step(bins_l, y, w, bag, pred, fmask_l, hyper: HyperScalars, key):
+        g, h = obj.grad_hess(pred, y, w)
+        stats = jnp.stack([g * bag, h * bag, (bag > 0).astype(jnp.float32)],
+                          axis=-1)
+        tree, row_leaf = grow_tree(
+            bins_l, stats, fmask_l, hyper.ctx(), num_leaves, num_bins,
+            hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
+            key=key, hist_impl=hist_impl, row_chunk=row_chunk,
+            hist_dtype=hist_dtype, wave_width=1, fp_axis=FEATURE_AXIS)
+        shrink = jnp.where(is_rf, 1.0, hyper.learning_rate)
+        new_pred = pred + shrink * tree.leaf_value[row_leaf]
+        return tree, new_pred
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(None, FEATURE_AXIS), P(), P(), P(), P(),
+                  P(FEATURE_AXIS), P(), P()),
+        out_specs=(P(), P()),
+        check_vma=False,  # tree replicated by construction via all_gather
+    )
+    return jax.jit(sharded)

@@ -136,3 +136,27 @@ def test_frontier_deterministic(small_regression):
     p1 = lgb.train(params, lgb.Dataset(X, label=y), 20).predict(X)
     p2 = lgb.train(params, lgb.Dataset(X, label=y), 20).predict(X)
     np.testing.assert_array_equal(p1, p2)
+
+
+def test_fused_goss_matches_host_loop():
+    """update_many's scanned GOSS path == per-round host GOSS updates."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(0)
+    n = 4000
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (X[:, 0] * 2 + np.sin(X[:, 1] * 3)
+         + rng.normal(0, 0.1, n)).astype(np.float32)
+    params = {"boosting": "goss", "objective": "regression",
+              "num_leaves": 15, "learning_rate": 0.2, "verbosity": -1,
+              "top_rate": 0.3, "other_rate": 0.2}
+    host = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                     num_boost_round=10, callbacks=[lambda env: None])
+    fused = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                      num_boost_round=10)
+    for th, tf in zip(host.trees, fused.trees):
+        np.testing.assert_array_equal(np.asarray(th.split_feature),
+                                      np.asarray(tf.split_feature))
+    np.testing.assert_allclose(host.predict(X), fused.predict(X),
+                               rtol=1e-5, atol=1e-6)

@@ -136,3 +136,31 @@ def test_train_api_tree_learner_data_with_bagging():
     assert dp._dp_mesh is not None
     np.testing.assert_allclose(serial.predict(X), dp.predict(X),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_train_api_tree_learner_feature_matches_serial():
+    """lgb.train(tree_learner='feature') on the 8-device mesh: feature-
+    sharded histograms + all_gather split exchange must reproduce the
+    serial model (SURVEY.md §2C feature-parallel row)."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(23)
+    n = 2500
+    X = rng.normal(size=(n, 10)).astype(np.float32)  # 10 cols over 8 shards
+    y = (X[:, 0] * 2 - X[:, 3] ** 2 + np.sin(X[:, 7] * 2)
+         + rng.normal(0, 0.1, n)).astype(np.float32)
+    params = {"objective": "regression", "num_leaves": 15,
+              "learning_rate": 0.2, "verbosity": -1,
+              "grow_policy": "leafwise"}
+    serial = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=10)
+    fp = lgb.train(dict(params, tree_learner="feature"),
+                   lgb.Dataset(X, label=y), num_boost_round=10)
+    assert fp._fp_mesh is not None, "FP path must engage on the 8-dev mesh"
+    for ts, tf in zip(serial.trees, fp.trees):
+        np.testing.assert_array_equal(np.asarray(ts.split_feature),
+                                      np.asarray(tf.split_feature))
+        np.testing.assert_array_equal(np.asarray(ts.split_bin),
+                                      np.asarray(tf.split_bin))
+    np.testing.assert_allclose(serial.predict(X), fp.predict(X),
+                               rtol=1e-5, atol=1e-5)
