@@ -180,7 +180,7 @@ def _rebuild_objective(key: tuple) -> Objective:
 def _goss_compact_round(bins, y, w, bag, pred, fmask, hyper: HyperScalars,
                         key, g, h, goss_k, num_leaves, num_bins, hist_impl,
                         row_chunk, hist_dtype, wave_width, cat_info,
-                        renew_alpha):
+                        renew_alpha, axis_name=None, sample_key=None):
     """One compacted GOSS round (shared by the per-round and scanned paths
     — the two MUST stay in RNG lockstep for fused == host training).
 
@@ -191,24 +191,30 @@ def _goss_compact_round(bins, y, w, bag, pred, fmask, hyper: HyperScalars,
     scores for ALL rows then come from one traversal pass."""
     k_top, k_other = goss_k
     n = bins.shape[0]
+    if sample_key is None:
+        sample_key = key  # sampling and growth share one stream (serial)
     g_abs = jnp.where(bag > 0, jnp.abs(g), -1.0)
     _, top_idx = jax.lax.top_k(g_abs, k_top)
     is_top = jnp.zeros(n, bool).at[top_idx].set(True)
     rest = (bag > 0) & ~is_top
-    u = jax.random.uniform(jax.random.fold_in(key, 0x7FFFFFFF), (n,))
+    u = jax.random.uniform(jax.random.fold_in(sample_key, 0x7FFFFFFF), (n,))
     _, other_idx = jax.lax.top_k(jnp.where(rest, u, -1.0), k_other)
     idx = jnp.concatenate([top_idx, other_idx])         # [k]
     amp = (1.0 - hyper.top_rate) / jnp.maximum(hyper.other_rate, 1e-12)
     wt = jnp.concatenate([jnp.ones(k_top, jnp.float32),
                           jnp.full(k_other, 1.0, jnp.float32) * amp])
+    # when live rows < the static k (small or heavily padded shards), dead
+    # rows get selected — mask their count (their g/h are already zero via
+    # the sample weights) so they cannot pollute min_data_in_leaf gating
+    live = (bag[idx] > 0).astype(jnp.float32)
+    wt = wt * live
     bins_c = jnp.take(bins, idx, axis=0)
-    stats = jnp.stack([g[idx] * wt, h[idx] * wt,
-                       jnp.ones(k_top + k_other, jnp.float32)], axis=-1)
+    stats = jnp.stack([g[idx] * wt, h[idx] * wt, live], axis=-1)
     tree, rl_c = grow_tree(
         bins_c, stats, fmask, hyper.ctx(), num_leaves, num_bins,
         hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode, key=key,
         hist_impl=hist_impl, row_chunk=row_chunk, hist_dtype=hist_dtype,
-        wave_width=wave_width, cat_info=cat_info)
+        wave_width=wave_width, cat_info=cat_info, axis_name=axis_name)
     if renew_alpha is not None:
         tree = renew_leaf_values(tree, rl_c, y[idx] - pred[idx],
                                  w[idx] * wt, renew_alpha)
@@ -549,13 +555,13 @@ class Booster:
         import warnings
 
         p = self.params
-        if (self._num_class > 1 or p.boosting in ("goss", "dart")
+        if (self._num_class > 1 or p.boosting == "dart"
                 or getattr(self.obj, "needs_group", False)
                 or getattr(self.obj, "renew_alpha", None) is not None
                 or self._cat_key is not None):
             warnings.warn(
                 f"tree_learner='{p.tree_learner}' currently supports "
-                "single-output non-ranking gbdt/rf boosting; training "
+                "single-output non-ranking gbdt/rf/goss boosting; training "
                 "serially", stacklevel=3)
             return
         n_pad = int(self.train_set.row_mask.shape[0])
@@ -773,12 +779,20 @@ class Booster:
         elif getattr(self, "_dp_mesh", None) is not None:
             from ..parallel.data_parallel import make_dp_train_step
 
+            goss_k_shard = None
+            if goss_k is not None:
+                # per-shard compaction (upstream's data-parallel GOSS
+                # samples per machine)
+                n_dev = self._dp_mesh.devices.size
+                goss_k_shard = (max(goss_k[0] // n_dev, 1),
+                                max(goss_k[1] // n_dev, 1))
+                eff_rows = sum(goss_k_shard)
             fn = make_dp_train_step(
                 self._dp_mesh, self._obj_key, p.num_leaves, self._num_bins,
                 p.extra.get("hist_impl", "auto"),
                 int(p.extra.get("row_chunk", 131072)), p.boosting == "rf",
                 resolve_wave_width(p, eff_rows),
-                resolve_hist_dtype(p, eff_rows))
+                resolve_hist_dtype(p, eff_rows), goss_k_shard)
             tree, new_pred = fn(self._dp_bins, self._dp_y, self._dp_w,
                                 self._bag, self._pred_train, fmask,
                                 self._hyper, round_key)

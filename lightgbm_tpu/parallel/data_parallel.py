@@ -77,7 +77,8 @@ def shard_rows(mesh: Mesh, *arrays):
 def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                        num_bins: int, hist_impl: str = "auto",
                        row_chunk: int = 131072, is_rf: bool = False,
-                       wave_width: int = 1, hist_dtype: str = "f32"):
+                       wave_width: int = 1, hist_dtype: str = "f32",
+                       goss_k_shard=None):
     """Build the jitted data-parallel round step for a mesh.
 
     Returns step(bins, y, w, bag, pred, feature_mask, hyper) ->
@@ -86,11 +87,32 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
     The entire per-round body — gradients, bagged stats, the full best-first
     growth loop with psum-merged histograms, and the train-score update —
     runs inside ONE ``shard_map``-ed program per round.
+
+    ``goss_k_shard``: static PER-SHARD (k_top, k_other) enabling GOSS —
+    each shard compacts its own rows (matching upstream's data-parallel
+    GOSS, which samples per machine) and the compacted shards' histograms
+    psum-merge as usual.
     """
     obj = _rebuild_objective(obj_key)
 
     def step(bins, y, w, bag, pred, feature_mask, hyper: HyperScalars, key):
         g, h = obj.grad_hess(pred, y, w)
+        if goss_k_shard is not None:
+            from ..models.gbdt import _goss_compact_round
+            from jax import lax
+
+            # ONLY the row-sampling stream differs per shard (upstream's
+            # per-machine sampling); the tree-growth key must stay SHARED
+            # or per-node feature sampling would pick different masks per
+            # shard and the "replicated" tree would silently diverge
+            sample_key = jax.random.fold_in(
+                key, lax.axis_index(DATA_AXIS))
+            tree, new_pred = _goss_compact_round(
+                bins, y, w, bag, pred, feature_mask, hyper, key,
+                g, h, goss_k_shard, num_leaves, num_bins, hist_impl,
+                row_chunk, hist_dtype, wave_width, None, None,
+                axis_name=DATA_AXIS, sample_key=sample_key)
+            return tree, new_pred
         stats = jnp.stack([g * bag, h * bag, bag], axis=-1)
         tree, row_leaf = grow_tree(
             bins, stats, feature_mask, hyper.ctx(), num_leaves, num_bins,
