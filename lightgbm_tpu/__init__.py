@@ -53,10 +53,14 @@ __all__ = [
 
 
 def __getattr__(name):
-    # sklearn-style estimators are imported lazily to keep `import
-    # lightgbm_tpu` light; they live in lightgbm_tpu.sklearn.
+    # sklearn-style estimators and plotting are imported lazily to keep
+    # `import lightgbm_tpu` light.
     if name in ("LGBMRegressor", "LGBMClassifier", "LGBMRanker", "LGBMModel"):
         from . import sklearn as _sk
 
         return getattr(_sk, name)
+    if name in ("plot_importance", "plot_metric", "create_tree_digraph"):
+        from . import plotting as _pl
+
+        return getattr(_pl, name)
     raise AttributeError(f"module 'lightgbm_tpu' has no attribute '{name}'")

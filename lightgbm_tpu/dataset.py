@@ -487,6 +487,15 @@ class Dataset:
             return self
         import jax.numpy as jnp  # deferred so Dataset import stays cheap
 
+        if isinstance(self.raw_data, str):
+            # a path: reload a save_binary() artifact (LightGBM's
+            # Dataset('train.bin') contract)
+            path = self.raw_data
+            if self.free_raw_data:
+                self.raw_data = None
+            self._load_binary(path)
+            return self
+
         p = parse_params(self.params, warn_unknown=False)
         X = _to_2d_float_array(self.raw_data)
         n, num_features = X.shape
@@ -561,6 +570,65 @@ class Dataset:
                      init_score=None, params=None) -> "Dataset":
         return Dataset(data, label=label, weight=weight, group=group,
                        init_score=init_score, reference=self, params=params or self.params)
+
+    def save_binary(self, filename: str) -> "Dataset":
+        """Persist the CONSTRUCTED (binned) dataset to one .npz file
+        (LightGBM ``Dataset.save_binary``): bin codes, labels/weights/
+        groups/init_score, bin mapper and EFB bundle map ride along, so
+        ``Dataset(filename)`` reloads without the raw data or a re-binning
+        pass."""
+        import json as _json
+
+        from .utils.serialize import mapper_to_dict
+
+        self.construct()
+        if not filename.endswith(".npz"):
+            filename += ".npz"  # numpy appends it anyway; keep load in sync
+        n = self.num_data_
+        payload = {
+            "codes": np.asarray(self.X_binned)[:n],
+            "mapper_json": np.frombuffer(
+                _json.dumps(mapper_to_dict(self.bin_mapper)).encode(),
+                dtype=np.uint8),
+            "feature_names": np.asarray(self.feature_names, dtype=object),
+            "raw_num_feature": np.int64(
+                getattr(self, "raw_num_feature_", None)
+                or self.num_feature_),
+        }
+        for name, arr in (("label", self._label), ("weight", self._weight),
+                          ("group", self._group),
+                          ("init_score", self._init_score)):
+            if arr is not None:
+                payload[name] = np.asarray(arr)
+        np.savez_compressed(filename, **payload)
+        return self
+
+    def _load_binary(self, filename: str) -> None:
+        import json as _json
+
+        from .utils.serialize import mapper_from_dict
+
+        import os
+
+        if not os.path.exists(filename) and not filename.endswith(".npz"):
+            filename += ".npz"  # save_binary normalizes to .npz
+        with np.load(filename, allow_pickle=True) as z:
+            codes = z["codes"].astype(np.uint8)
+            self.bin_mapper = mapper_from_dict(
+                _json.loads(bytes(z["mapper_json"]).decode()))
+            self.feature_names = [str(s) for s in z["feature_names"]]
+            self.raw_num_feature_ = int(z["raw_num_feature"])
+            # constructor arguments take precedence over the stored fields
+            # (Dataset(path, label=new_y) means the NEW labels)
+            if self._label is None and "label" in z:
+                self._label = z["label"]
+            if self._weight is None and "weight" in z:
+                self._weight = z["weight"]
+            if self._group is None and "group" in z:
+                self._group = z["group"]
+            if self._init_score is None and "init_score" in z:
+                self._init_score = z["init_score"]
+        self._from_codes(codes)
 
     def subset(self, used_indices, params=None) -> "Dataset":
         """Row-subset sharing this dataset's bin mapper (used by cv folds)."""

@@ -1291,6 +1291,94 @@ class Booster:
                      start_iteration=start_iteration)
         return self
 
+    def refit(self, data, label, decay_rate: float = 0.9,
+              weight=None, **kwargs) -> "Booster":
+        """Refit leaf values on new data, keeping every tree's structure
+        (LightGBM ``Booster.refit``): sequentially per tree, the new leaf
+        value is ``decay_rate * old + (1 - decay_rate) * newton`` where the
+        Newton step comes from the new data's grad/hess at the ensemble's
+        running prediction.  Returns a NEW booster; self is untouched.
+        """
+        import copy as _copy
+
+        if self._num_class > 1:
+            raise NotImplementedError("refit with multiclass is not "
+                                      "supported yet")
+        if self.params.boosting in ("rf", "dart"):
+            raise NotImplementedError(
+                "refit supports additive boosting (gbdt/goss); rf averages "
+                "trees and dart bakes dropout scales into leaf values")
+        if getattr(self.obj, "needs_group", False):
+            raise NotImplementedError(
+                "refit with group objectives (lambdarank) needs regrouped "
+                "data; not supported yet")
+        if kwargs:
+            raise TypeError(f"refit got unsupported arguments: "
+                            f"{sorted(kwargs)}")
+        from ..dataset import _to_2d_float_array
+
+        X = _to_2d_float_array(data)
+        y = jnp.asarray(np.asarray(label, np.float32))
+        w = (jnp.ones_like(y) if weight is None
+             else jnp.asarray(np.asarray(weight, np.float32)))
+        codes = jnp.asarray(self._bin_mapper_for_predict().transform(X))
+        p = self.params
+        lam = jnp.float32(p.lambda_l2)
+        decay = jnp.float32(decay_rate)
+        lr = jnp.float32(p.learning_rate)
+        obj = self.obj
+        depth_cap = self._depth_cap
+
+        @jax.jit
+        def one_tree(tree, pred):
+            n = codes.shape[0]
+            b32 = codes.astype(jnp.int32)
+
+            def step(node, _):
+                feat = tree.split_feature[node]
+                thr = tree.split_bin[node]
+                code = jnp.take_along_axis(b32, feat[:, None], axis=1)[:, 0]
+                left = code <= thr
+                if tree.is_cat_split is not None:
+                    left = jnp.where(tree.is_cat_split[node],
+                                     tree.cat_mask[node, code], left)
+                nxt = jnp.where(left, tree.left[node], tree.right[node])
+                return jnp.where(tree.is_leaf[node], node, nxt), None
+
+            leafs, _ = lax.scan(step, jnp.zeros(n, jnp.int32), None,
+                                length=depth_cap)
+            g, h = obj.grad_hess(pred, y, w)
+            m = tree.leaf_value.shape[0]
+            gs = jnp.zeros(m, jnp.float32).at[leafs].add(g)
+            hs = jnp.zeros(m, jnp.float32).at[leafs].add(h)
+            cnt = jnp.zeros(m, jnp.float32).at[leafs].add(1.0)
+            newton = -gs / (hs + lam + 1e-15)
+            vals = jnp.where(tree.is_leaf & (cnt > 0),
+                             decay * tree.leaf_value
+                             + (1.0 - decay) * newton,
+                             tree.leaf_value)
+            new_tree = tree._replace(leaf_value=vals)
+            return new_tree, pred + lr * vals[leafs]
+
+        pred = jnp.full(codes.shape[0], float(self.init_score_), jnp.float32)
+        new_trees = []
+        for t in self.trees:
+            nt, pred = one_tree(t, pred)
+            new_trees.append(nt)
+        out = _copy.copy(self)
+        out.trees = new_trees
+        out._forest_cache = None
+        out._valid = []
+        # the refit booster is predict-only: its training-state caches
+        # (_pred_train/_bag) reflect the OLD leaf values, so continuing
+        # training on it would fit wrong residuals
+        out.train_set = None
+        out._bin_mapper = self._bin_mapper_for_predict()
+        out._feature_names = list(self.feature_name())
+        out._pred_train = None
+        out._bag = None
+        return out
+
     def dump_model(self, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> Dict[str, Any]:
         """Nested-dict model dump (LightGBM ``dump_model`` contract)."""

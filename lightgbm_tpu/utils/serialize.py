@@ -75,6 +75,36 @@ def _tree_from_dict(d: dict):
     )
 
 
+def mapper_to_dict(mapper) -> dict:
+    """BinMapper (+ attached EFB bundler) -> JSON-ready dict."""
+    return {
+        "upper_bounds": [ub.tolist() for ub in mapper.upper_bounds],
+        "nan_bin": mapper.nan_bin.tolist(),
+        "n_bins": mapper.n_bins.tolist(),
+        "is_categorical": mapper.is_categorical.astype(int).tolist(),
+        "bundler": (None if mapper.bundler is None else {
+            "groups": mapper.bundler.groups,
+            "default_bins": mapper.bundler.default_bins.tolist(),
+        }),
+    }
+
+
+def mapper_from_dict(bm: dict):
+    from ..dataset import BinMapper, FeatureBundler
+
+    mapper = BinMapper(
+        [np.asarray(ub, np.float64) for ub in bm["upper_bounds"]],
+        np.asarray(bm["nan_bin"], np.int32),
+        np.asarray(bm["n_bins"], np.int32),
+        np.asarray(bm["is_categorical"], bool),
+    )
+    if bm.get("bundler"):
+        mapper.bundler = FeatureBundler(
+            bm["bundler"]["groups"], mapper.n_bins,
+            np.asarray(bm["bundler"]["default_bins"], np.int64))
+    return mapper
+
+
 def booster_to_string(booster, num_iteration: Optional[int] = None,
                       start_iteration: int = 0) -> str:
     k = (len(booster.trees) if num_iteration is None or num_iteration <= 0
@@ -96,16 +126,7 @@ def booster_to_string(booster, num_iteration: Optional[int] = None,
         "feature_names": (booster.train_set.feature_names
                           if booster.train_set is not None
                           else getattr(booster, "_feature_names", None)),
-        "bin_mapper": {
-            "upper_bounds": [ub.tolist() for ub in mapper.upper_bounds],
-            "nan_bin": mapper.nan_bin.tolist(),
-            "n_bins": mapper.n_bins.tolist(),
-            "is_categorical": mapper.is_categorical.astype(int).tolist(),
-            "bundler": (None if mapper.bundler is None else {
-                "groups": mapper.bundler.groups,
-                "default_bins": mapper.bundler.default_bins.tolist(),
-            }),
-        },
+        "bin_mapper": mapper_to_dict(mapper),
         "trees": [_tree_to_dict(t) for t in booster.trees[start:start + k]],
     }
     doc["num_trees"] = len(doc["trees"])
@@ -233,7 +254,6 @@ def load_booster_into(booster, model_file: Optional[str] = None,
     """Populate a bare Booster instance from a saved model."""
     import jax
     from ..config import parse_params
-    from ..dataset import BinMapper
     from ..objectives import create_objective
 
     if model_str is None:
@@ -262,15 +282,4 @@ def load_booster_into(booster, model_file: Optional[str] = None,
     booster._bag = None
     booster._key = jax.random.PRNGKey(booster.params.seed)
     booster._feature_names = doc.get("feature_names")
-    bm = doc["bin_mapper"]
-    booster._bin_mapper = BinMapper(
-        [np.asarray(ub, np.float64) for ub in bm["upper_bounds"]],
-        np.asarray(bm["nan_bin"], np.int32),
-        np.asarray(bm["n_bins"], np.int32),
-        np.asarray(bm["is_categorical"], bool),
-    )
-    if bm.get("bundler"):
-        from ..dataset import FeatureBundler
-        booster._bin_mapper.bundler = FeatureBundler(
-            bm["bundler"]["groups"], booster._bin_mapper.n_bins,
-            np.asarray(bm["bundler"]["default_bins"], np.int64))
+    booster._bin_mapper = mapper_from_dict(doc["bin_mapper"])

@@ -1,0 +1,117 @@
+"""API-parity surface: refit, save_binary, plotting helpers."""
+
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(3)
+    n = 3000
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (X[:, 0] * 2 + np.sin(X[:, 1] * 3)
+         + rng.normal(0, 0.1, n)).astype(np.float32)
+    return X, y
+
+
+def test_refit_on_shifted_data(data):
+    """refit keeps structure, adapts leaf values toward the new targets."""
+    X, y = data
+    params = {"objective": "regression", "num_leaves": 15,
+              "learning_rate": 0.2, "verbosity": -1}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=20)
+    y_shift = y + 3.0
+    b2 = b.refit(X, y_shift, decay_rate=0.5)
+    # structures identical
+    for t1, t2 in zip(b.trees, b2.trees):
+        np.testing.assert_array_equal(np.asarray(t1.split_feature),
+                                      np.asarray(t2.split_feature))
+    # original untouched; refit moves toward the shifted target
+    e_old = float(np.mean(np.abs(b.predict(X) - y_shift)))
+    e_new = float(np.mean(np.abs(b2.predict(X) - y_shift)))
+    assert e_new < e_old, (e_new, e_old)
+
+
+def test_save_binary_roundtrip(data, tmp_path):
+    X, y = data
+    d1 = lgb.Dataset(X, label=y)
+    d1.construct()
+    path = str(tmp_path / "train.bin.npz")
+    d1.save_binary(path)
+
+    d2 = lgb.Dataset(path)
+    d2.construct()
+    np.testing.assert_array_equal(np.asarray(d1.X_binned),
+                                  np.asarray(d2.X_binned))
+    np.testing.assert_allclose(d1.get_label(), d2.get_label())
+    # training from the reloaded binary matches training from raw
+    params = {"objective": "regression", "num_leaves": 15, "verbosity": -1}
+    b1 = lgb.train(dict(params), d1, num_boost_round=5)
+    b2 = lgb.train(dict(params), lgb.Dataset(path), num_boost_round=5)
+    np.testing.assert_allclose(b1.predict(X), b2.predict(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_plot_importance_and_metric(data, tmp_path):
+    X, y = data
+    from lightgbm_tpu.plotting import plot_importance, plot_metric
+
+    evals = {}
+    dtrain = lgb.Dataset(X[:2500], label=y[:2500])
+    dvalid = dtrain.create_valid(X[2500:], label=y[2500:])
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "verbosity": -1}, dtrain, num_boost_round=10,
+                  valid_sets=[dvalid], evals_result=evals)
+    ax = plot_importance(b)
+    assert len(ax.patches) > 0
+    ax2 = plot_metric(evals)
+    assert len(ax2.lines) >= 1
+
+
+def test_create_tree_digraph(data):
+    X, y = data
+    from lightgbm_tpu.plotting import create_tree_digraph
+
+    b = lgb.train({"objective": "regression", "num_leaves": 7,
+                   "verbosity": -1}, lgb.Dataset(X, label=y),
+                  num_boost_round=3)
+    dot = create_tree_digraph(b, tree_index=1)
+    assert dot.startswith("digraph Tree {") and dot.endswith("}")
+    assert dot.count("->") == 2 * 6  # 6 internal nodes, yes+no edges
+    assert "leaf" in dot
+
+
+def test_save_binary_bin_suffix_roundtrip(data, tmp_path):
+    """The LightGBM Dataset('train.bin') contract: save_binary normalizes
+    the numpy .npz suffix so the SAME path string reloads."""
+    X, y = data
+    d1 = lgb.Dataset(X, label=y)
+    path = str(tmp_path / "train.bin")
+    d1.save_binary(path)
+    d2 = lgb.Dataset(path)
+    d2.construct()
+    np.testing.assert_allclose(d1.get_label(), d2.get_label())
+    # constructor label overrides the stored one
+    y2 = y + 1.0
+    d3 = lgb.Dataset(path, label=y2)
+    d3.construct()
+    np.testing.assert_allclose(d3.get_label(), y2)
+
+
+def test_refit_weight_and_guardrails(data):
+    X, y = data
+    params = {"objective": "regression", "num_leaves": 15, "verbosity": -1}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    w = np.ones(len(y), np.float32)
+    w[: len(y) // 2] = 10.0
+    b_w = b.refit(X, y + 1.0, weight=w)
+    b_u = b.refit(X, y + 1.0)
+    assert not np.allclose(b_w.predict(X[:50]), b_u.predict(X[:50]))
+    with pytest.raises(TypeError):
+        b.refit(X, y, bogus_arg=1)
+    # refit boosters are predict-only
+    assert b_w.train_set is None
