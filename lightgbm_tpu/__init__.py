@@ -55,11 +55,13 @@ __all__ = [
 def __getattr__(name):
     # sklearn-style estimators and plotting are imported lazily to keep
     # `import lightgbm_tpu` light.
-    if name in ("LGBMRegressor", "LGBMClassifier", "LGBMRanker", "LGBMModel"):
+    if name in ("LGBMRegressor", "LGBMClassifier", "LGBMRanker", "LGBMModel",
+                "LGBMRandomForestRegressor"):
         from . import sklearn as _sk
 
         return getattr(_sk, name)
-    if name in ("plot_importance", "plot_metric", "create_tree_digraph"):
+    if name in ("plot_importance", "plot_metric", "create_tree_digraph",
+                "plot_split_value_histogram"):
         from . import plotting as _pl
 
         return getattr(_pl, name)

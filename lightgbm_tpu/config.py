@@ -101,6 +101,14 @@ _ALIASES: Dict[str, str] = {
     "colsample_bynode": "feature_fraction_bynode",
     "feature_fraction_seed": "feature_fraction_seed",
     "extra_trees": "extra_trees",
+    "monotone_constraints": "monotone_constraints",
+    "mc": "monotone_constraints",
+    "monotone_constraint": "monotone_constraints",
+    "monotonic_cst": "monotone_constraints",
+    "monotone_constraints_method": "monotone_constraints_method",
+    "monotone_constraining_method": "monotone_constraints_method",
+    "mc_method": "monotone_constraints_method",
+    "path_smooth": "path_smooth",
     "grow_policy": "grow_policy",
     "growth_policy": "grow_policy",
     "early_stopping_round": "early_stopping_round",
@@ -306,6 +314,10 @@ class Params:
     feature_fraction_bynode: float = 1.0
     feature_fraction_seed: int = 2
     extra_trees: bool = False
+    # monotone constraints (basic method) + leaf-path smoothing
+    monotone_constraints: Optional[List[int]] = None
+    monotone_constraints_method: str = "basic"
+    path_smooth: float = 0.0
     # leafwise = strict LightGBM best-first (one split per histogram pass);
     # frontier = wave growth with histogram subtraction (up to wave_width
     # splits per pass — the large-data fast path); auto picks by data size.
@@ -364,6 +376,8 @@ class Params:
             metric=list(self.metric),
             eval_at=list(self.eval_at),
             extra=dict(self.extra),
+            monotone_constraints=(None if self.monotone_constraints is None
+                                  else list(self.monotone_constraints)),
         )
 
 
@@ -449,6 +463,11 @@ def parse_params(
             if bv is None:
                 raise ValueError(f"Unknown boosting type: {value!r}")
             out.boosting = bv
+        elif canon == "monotone_constraints":
+            # accepts LightGBM's "+1,0,-1" string form or any int sequence
+            if isinstance(value, str):
+                value = [v.strip() for v in value.split(",") if v.strip()]
+            out.monotone_constraints = [int(v) for v in value]
         elif canon in ("label_gain", "eval_at"):
             if isinstance(value, str):
                 value = [float(v) for v in value.split(",")]
@@ -477,6 +496,25 @@ def _validate(p: Params) -> None:
     if p.grow_policy not in ("auto", "leafwise", "frontier"):
         raise ValueError(
             f"grow_policy must be auto/leafwise/frontier, got {p.grow_policy}")
+    if p.monotone_constraints is not None:
+        if any(c not in (-1, 0, 1) for c in p.monotone_constraints):
+            raise ValueError(
+                "monotone_constraints entries must be -1, 0, or 1, got "
+                f"{p.monotone_constraints}")
+        if p.monotone_constraints_method not in (
+                "basic", "intermediate", "advanced"):
+            raise ValueError(
+                "monotone_constraints_method must be basic/intermediate/"
+                f"advanced, got {p.monotone_constraints_method!r}")
+        if p.monotone_constraints_method != "basic":
+            warnings.warn(
+                f"monotone_constraints_method="
+                f"'{p.monotone_constraints_method}' falls back to 'basic' "
+                "(the mid-point bound method); constraints are still "
+                "enforced exactly, only split selection is more "
+                "conservative")
+    if p.path_smooth < 0:
+        raise ValueError(f"path_smooth must be >= 0, got {p.path_smooth}")
     if p.boosting == "rf":
         if p.bagging_freq <= 0 or not (0.0 < p.bagging_fraction < 1.0):
             # LightGBM requires bagging for rf mode; default to sklearn-ish bootstrap

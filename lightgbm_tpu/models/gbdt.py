@@ -45,6 +45,8 @@ class HyperScalars(NamedTuple):
     feature_fraction_bynode: jnp.ndarray
     top_rate: jnp.ndarray        # GOSS a (used only when boosting="goss")
     other_rate: jnp.ndarray      # GOSS b
+    max_delta_step: jnp.ndarray = 0.0   # |leaf output| cap (<=0 = off)
+    path_smooth: jnp.ndarray = 0.0      # child-output smoothing (0 = off)
 
     @staticmethod
     def from_params(p: Params) -> "HyperScalars":
@@ -59,6 +61,8 @@ class HyperScalars(NamedTuple):
             feature_fraction_bynode=jnp.float32(p.feature_fraction_bynode),
             top_rate=jnp.float32(p.top_rate),
             other_rate=jnp.float32(p.other_rate),
+            max_delta_step=jnp.float32(p.max_delta_step),
+            path_smooth=jnp.float32(p.path_smooth),
         )
 
     def ctx(self) -> SplitContext:
@@ -68,6 +72,8 @@ class HyperScalars(NamedTuple):
             min_data_in_leaf=self.min_data_in_leaf,
             min_sum_hessian=self.min_sum_hessian,
             min_gain_to_split=self.min_gain_to_split,
+            max_delta_step=self.max_delta_step,
+            path_smooth=self.path_smooth,
         )
 
 
@@ -180,7 +186,8 @@ def _rebuild_objective(key: tuple) -> Objective:
 def _goss_compact_round(bins, y, w, bag, pred, fmask, hyper: HyperScalars,
                         key, g, h, goss_k, num_leaves, num_bins, hist_impl,
                         row_chunk, hist_dtype, wave_width, cat_info,
-                        renew_alpha, axis_name=None, sample_key=None):
+                        renew_alpha, axis_name=None, sample_key=None,
+                        mono=None, extra_trees=False, col_bins=None):
     """One compacted GOSS round (shared by the per-round and scanned paths
     — the two MUST stay in RNG lockstep for fused == host training).
 
@@ -214,7 +221,8 @@ def _goss_compact_round(bins, y, w, bag, pred, fmask, hyper: HyperScalars,
         bins_c, stats, fmask, hyper.ctx(), num_leaves, num_bins,
         hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode, key=key,
         hist_impl=hist_impl, row_chunk=row_chunk, hist_dtype=hist_dtype,
-        wave_width=wave_width, cat_info=cat_info, axis_name=axis_name)
+        wave_width=wave_width, cat_info=cat_info, axis_name=axis_name,
+        mono=mono, extra_trees=extra_trees, col_bins=col_bins)
     if renew_alpha is not None:
         tree = renew_leaf_values(tree, rl_c, y[idx] - pred[idx],
                                  w[idx] * wt, renew_alpha)
@@ -228,13 +236,20 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
               hist_impl: str, row_chunk: int, is_rf: bool,
               num_class: int = 1, hist_dtype: str = "f32",
               wave_width: int = 1, goss_k: Optional[Tuple[int, int]] = None,
-              cat_key: Optional[tuple] = None):
+              cat_key: Optional[tuple] = None,
+              mono_key: Optional[tuple] = None, extra_trees: bool = False,
+              nbins_key: Optional[tuple] = None):
     """goss_k: static (k_top, k_other) row counts enabling the compacted
     GOSS path; None = plain gbdt/rf.  cat_key: static categorical-split
-    configuration (see _build_cat_info)."""
+    configuration (see _build_cat_info).  mono_key: static per-feature
+    monotone constraints tuple (upstream ``monotone_constraints``)."""
     obj = _rebuild_objective(obj_key)
     is_goss = goss_k is not None
     renew_alpha = getattr(obj, "renew_alpha", None)
+    mono_arr = (None if mono_key is None
+                else jnp.asarray(mono_key, jnp.int32))
+    colb = (None if nbins_key is None
+            else jnp.asarray(nbins_key, jnp.int32))
 
     def goss_bag(key, g, bag, hyper):
         """GOSS as row re-weighting (multiclass path): top-|g| keep +
@@ -263,7 +278,8 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                     ff_bynode=hyper.feature_fraction_bynode, key=kc,
                     hist_impl=hist_impl, row_chunk=row_chunk,
                     hist_dtype=hist_dtype, wave_width=wave_width,
-                    cat_info=_build_cat_info(cat_key, bins.shape[1]))
+                    cat_info=_build_cat_info(cat_key, bins.shape[1]),
+                    mono=mono_arr, extra_trees=extra_trees, col_bins=colb)
 
             keys = jax.random.split(key, num_class)
             trees, row_leafs = jax.vmap(grow_one, in_axes=(1, 1, 0))(
@@ -285,7 +301,8 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                 bins, y, w, bag, pred, feature_mask, hyper, key, g, h,
                 goss_k, num_leaves, num_bins, hist_impl, row_chunk,
                 hist_dtype, wave_width,
-                _build_cat_info(cat_key, bins.shape[1]), renew_alpha)
+                _build_cat_info(cat_key, bins.shape[1]), renew_alpha,
+                mono=mono_arr, extra_trees=extra_trees, col_bins=colb)
 
         return round_fn_goss
 
@@ -300,7 +317,8 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
             hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
             key=key, hist_impl=hist_impl, row_chunk=row_chunk,
             hist_dtype=hist_dtype, wave_width=wave_width,
-            cat_info=_build_cat_info(cat_key, bins.shape[1]))
+            cat_info=_build_cat_info(cat_key, bins.shape[1]),
+            mono=mono_arr, extra_trees=extra_trees, col_bins=colb)
         if renew_alpha is not None:
             tree = renew_leaf_values(tree, row_leaf, y - pred, w * bag,
                                      renew_alpha)
@@ -317,7 +335,10 @@ def _multi_round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                     hist_dtype: str, wave_width: int, n_rounds: int,
                     bagging_freq: int, use_ff: bool,
                     cat_key: Optional[tuple] = None,
-                    goss_k: Optional[Tuple[int, int]] = None):
+                    goss_k: Optional[Tuple[int, int]] = None,
+                    mono_key: Optional[tuple] = None,
+                    extra_trees: bool = False,
+                    nbins_key: Optional[tuple] = None):
     """``n_rounds`` boosting rounds as ONE device program (`lax.scan`).
 
     The host round loop pays a dispatch round-trip per boosting round —
@@ -331,6 +352,10 @@ def _multi_round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
     """
     obj = _rebuild_objective(obj_key)
     renew_alpha = getattr(obj, "renew_alpha", None)
+    mono_arr = (None if mono_key is None
+                else jnp.asarray(mono_key, jnp.int32))
+    colb = (None if nbins_key is None
+            else jnp.asarray(nbins_key, jnp.int32))
 
     @jax.jit
     def multi(bins, y, w, bag0, pred0, hyper: HyperScalars, round_key,
@@ -362,7 +387,8 @@ def _multi_round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                 tree, new_pred = _goss_compact_round(
                     bins, y, w, bag, pred, fmask, hyper, rkey, g, h,
                     goss_k, num_leaves, num_bins, hist_impl, row_chunk,
-                    hist_dtype, wave_width, cat_info, renew_alpha)
+                    hist_dtype, wave_width, cat_info, renew_alpha,
+                    mono=mono_arr, extra_trees=extra_trees, col_bins=colb)
                 return (new_pred, bag), tree
             stats = jnp.stack(
                 [g * bag, h * bag, (bag > 0).astype(jnp.float32)], axis=-1)
@@ -372,7 +398,8 @@ def _multi_round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                 key=rkey, hist_impl=hist_impl,
                 row_chunk=row_chunk, hist_dtype=hist_dtype,
                 wave_width=wave_width,
-                cat_info=cat_info)
+                cat_info=cat_info, mono=mono_arr, extra_trees=extra_trees,
+                col_bins=colb)
             if renew_alpha is not None:
                 tree = renew_leaf_values(tree, row_leaf, y - pred, w * bag,
                                          renew_alpha)
@@ -535,12 +562,62 @@ class Booster:
             (tuple(int(c) for c in cats), float(p.cat_smooth),
              float(p.cat_l2), int(p.max_cat_threshold))
             if len(cats) else None)
+        self._mono_key = self._resolve_monotone_constraints()
+        # per-training-column used-bin counts bound the extra_trees draw
+        # (code-review r2: a global [0, num_bins) draw starves
+        # low-cardinality features of valid thresholds)
+        if p.extra_trees:
+            bmm = ds.bin_mapper
+            colb = (bmm.bundler.col_bins if bmm.bundler is not None
+                    else [int(x) for x in bmm.n_bins])
+            self._nbins_key = tuple(int(x) for x in colb)
+        else:
+            self._nbins_key = None
         self._dp_mesh = None
         self._fp_mesh = None
         if p.tree_learner == "feature":
             self._maybe_setup_fp()
         elif p.tree_learner in ("data", "voting"):
             self._maybe_setup_dp()
+
+    def _resolve_monotone_constraints(self) -> Optional[tuple]:
+        """Map user ``monotone_constraints`` (per ORIGINAL feature) onto the
+        TRAINING columns (post-EFB), validating LightGBM's rules: the list
+        must cover every feature and categorical features cannot be
+        constrained (a category set has no order to be monotone in).
+
+        Returns a static tuple for the jit-compile cache, or None when no
+        constraint is active.
+        """
+        p = self.params
+        mc = p.monotone_constraints
+        if mc is None or not any(int(c) != 0 for c in mc):
+            return None
+        bm = self.train_set.bin_mapper
+        if len(mc) != bm.num_features:
+            raise ValueError(
+                f"monotone_constraints has {len(mc)} entries for "
+                f"{bm.num_features} features")
+        for f, c in enumerate(mc):
+            if c != 0 and bm.is_categorical[f]:
+                raise ValueError(
+                    f"monotone constraint on categorical feature {f} is "
+                    "not supported (matching lightgbm)")
+        b = bm.bundler
+        if b is None:
+            return tuple(int(c) for c in mc)
+        train_mc = []
+        for g in b.groups:
+            if len(g) == 1:
+                train_mc.append(int(mc[g[0]]))
+            elif any(int(mc[f]) != 0 for f in g):
+                raise ValueError(
+                    "monotone constraint on an EFB-bundled feature "
+                    f"(bundle members {g}); pass enable_bundle=False "
+                    "when constraining sparse features")
+            else:
+                train_mc.append(0)
+        return tuple(train_mc)
 
     def _maybe_setup_dp(self) -> None:
         """Shard the training arrays over the local device mesh when the
@@ -596,12 +673,14 @@ class Booster:
                 or getattr(self.obj, "needs_group", False)
                 or getattr(self.obj, "renew_alpha", None) is not None
                 or self._cat_key is not None
+                or self._mono_key is not None or p.extra_trees
                 or p.feature_fraction_bynode < 1.0):
             warnings.warn(
                 "tree_learner='feature' currently supports single-output "
-                "non-ranking, non-categorical gbdt/rf without per-node "
-                "feature sampling (bynode would sample per SHARD and "
-                "diverge from serial); training serially", stacklevel=3)
+                "non-ranking, non-categorical, unconstrained gbdt/rf "
+                "without per-node feature sampling (bynode would sample "
+                "per SHARD and diverge from serial); training serially",
+                stacklevel=3)
             return
         n_dev = len(jax.devices())
         if n_dev <= 1:
@@ -792,7 +871,8 @@ class Booster:
                 p.extra.get("hist_impl", "auto"),
                 int(p.extra.get("row_chunk", 131072)), p.boosting == "rf",
                 resolve_wave_width(p, eff_rows),
-                resolve_hist_dtype(p, eff_rows), goss_k_shard)
+                resolve_hist_dtype(p, eff_rows), goss_k_shard,
+                self._mono_key, p.extra_trees, self._nbins_key)
             tree, new_pred = fn(self._dp_bins, self._dp_y, self._dp_w,
                                 self._bag, self._pred_train, fmask,
                                 self._hyper, round_key)
@@ -803,7 +883,8 @@ class Booster:
                            p.boosting == "rf", self._num_class,
                            resolve_hist_dtype(p, eff_rows),
                            resolve_wave_width(p, eff_rows), goss_k,
-                           self._cat_key)
+                           self._cat_key, self._mono_key, p.extra_trees,
+                           self._nbins_key)
             tree, new_pred = fn(ds.X_binned, ds.y, self._w_eff, self._bag,
                                 self._pred_train, fmask, self._hyper,
                                 round_key)
@@ -875,7 +956,8 @@ class Booster:
                 resolve_hist_dtype(p, eff_rows),
                 resolve_wave_width(p, eff_rows), n_rounds,
                 p.bagging_freq if use_bagging else 0, use_ff,
-                self._cat_key, goss_k)
+                self._cat_key, goss_k, self._mono_key, p.extra_trees,
+                self._nbins_key)
             pred, bag, trees = fn(
                 ds.X_binned, ds.y, self._w_eff, self._bag, self._pred_train,
                 self._hyper, self._key, bag_key, ff_key, ds.row_mask,
@@ -948,7 +1030,8 @@ class Booster:
                        p.extra.get("hist_impl", "auto"),
                        int(p.extra.get("row_chunk", 131072)), False, 1,
                        resolve_hist_dtype(p, eff_rows),
-                       resolve_wave_width(p, eff_rows), None, self._cat_key)
+                       resolve_wave_width(p, eff_rows), None, self._cat_key,
+                       self._mono_key, p.extra_trees, self._nbins_key)
         round_key = jax.random.fold_in(self._key, i)
         tree, new_pred = fn(ds.X_binned, ds.y, self._w_eff, self._bag, pred,
                             fmask, self._hyper, round_key)
@@ -1120,6 +1203,7 @@ class Booster:
         num_iteration: Optional[int] = None,
         raw_score: bool = False,
         pred_leaf: bool = False,
+        pred_contrib: bool = False,
         start_iteration: int = 0,
         ntree_limit: Optional[int] = None,  # xgboost-style alias
         **kwargs,
@@ -1128,6 +1212,10 @@ class Booster:
 
         ``num_iteration``/``ntree_limit`` truncate to the first k trees —
         the staged-prediction contract of bagging_boosting.ipynb:136.
+        ``pred_contrib`` returns exact path-dependent TreeSHAP values
+        ``[n, F+1]`` (``[n, K*(F+1)]`` multiclass) in raw-score space with
+        the expected value in the last column, matching LightGBM's
+        ``predict(..., pred_contrib=True)`` contract (ops/shap.py).
         """
         if num_iteration is None:
             num_iteration = ntree_limit
@@ -1150,18 +1238,21 @@ class Booster:
         bins = jnp.asarray(codes)
         forest = self._stacked_forest()
         if pred_leaf:
-            if self._num_class > 1:
-                raise NotImplementedError("pred_leaf with multiclass")
+            # LightGBM contract: [n, num_iteration * num_class], iteration-
+            # major, values are per-tree leaf ordinals in [0, num_leaves)
+            # — not node-array slots (ADVICE r1): rank leaf slots by node id
             leaves = []
             for t in range(start_iteration, start_iteration + num_iteration):
-                tree = jax.tree.map(lambda a: a[t], forest)
-                node = self._leaf_index(tree, bins)
-                # LightGBM's pred_leaf contract: per-tree leaf ordinals in
-                # [0, num_leaves), not node-array slots (ADVICE r1) — rank
-                # each leaf slot by node id
-                ordinal = jnp.cumsum(tree.is_leaf.astype(jnp.int32)) - 1
-                leaves.append(np.asarray(ordinal[node]))
+                for c in range(self._num_class):
+                    tree = jax.tree.map(
+                        (lambda a: a[t]) if self._num_class == 1
+                        else (lambda a: a[t, c]), forest)
+                    node = self._leaf_index(tree, bins)
+                    ordinal = jnp.cumsum(tree.is_leaf.astype(jnp.int32)) - 1
+                    leaves.append(np.asarray(ordinal[node]))
             return np.stack(leaves, axis=1)
+        if pred_contrib:
+            return self._pred_contrib(bins, start_iteration, num_iteration)
         shrink = 1.0 if self.params.boosting == "rf" else self.params.learning_rate
         k = self._num_class
         if k > 1:
@@ -1186,6 +1277,47 @@ class Booster:
         if raw_score:
             return np.asarray(raw)
         return np.asarray(self.obj.transform(raw))
+
+    def _pred_contrib(self, bins, start: int, num: int) -> np.ndarray:
+        """Exact TreeSHAP contributions over the selected trees.
+
+        Reported per ORIGINAL feature (EFB bundle splits resolved through
+        the bundle map); the bias column carries the per-tree expected
+        values plus the init score, so rows sum to the raw prediction.
+        """
+        from ..ops.shap import forest_pred_contrib
+
+        bm = self._bin_mapper_for_predict()
+        f_orig = bm.num_features
+        bundler = bm.bundler
+        p = self.params
+        k = self._num_class
+        sel = self.trees[start:start + num]
+        caps = {int(t.split_feature.shape[-1]) for t in sel}
+        if len(caps) > 1:  # init_model continuation with mixed num_leaves
+            sel = [pad_tree(t, max(caps)) for t in sel]
+        fields = [f for f in Tree._fields
+                  if getattr(sel[0], f, None) is not None] if sel else []
+
+        def to_np(t, c=None):
+            return {f: np.asarray(getattr(t, f) if c is None
+                                  else getattr(t, f)[c]) for f in fields}
+
+        is_rf = p.boosting == "rf"
+        shrink = np.full(len(sel), 1.0 if is_rf else p.learning_rate,
+                         np.float32)
+        outs = []
+        for c in range(k):
+            tree_dicts = [to_np(t, c if k > 1 else None) for t in sel]
+            phi = forest_pred_contrib(tree_dicts, bins, f_orig, shrink,
+                                      bundler=bundler)
+            if is_rf and len(sel) > 0:
+                phi /= len(sel)
+            init = (float(self.init_score_[c]) if k > 1
+                    else float(np.float32(self.init_score_)))
+            phi[:, -1] += init
+            outs.append(phi)
+        return np.concatenate(outs, axis=1) if k > 1 else outs[0]
 
     def _leaf_index(self, tree: Tree, bins) -> jnp.ndarray:
         from jax import lax
@@ -1301,9 +1433,6 @@ class Booster:
         """
         import copy as _copy
 
-        if self._num_class > 1:
-            raise NotImplementedError("refit with multiclass is not "
-                                      "supported yet")
         if self.params.boosting in ("rf", "dart"):
             raise NotImplementedError(
                 "refit supports additive boosting (gbdt/goss); rf averages "
@@ -1329,8 +1458,7 @@ class Booster:
         obj = self.obj
         depth_cap = self._depth_cap
 
-        @jax.jit
-        def one_tree(tree, pred):
+        def leaf_of(tree):
             n = codes.shape[0]
             b32 = codes.astype(jnp.int32)
 
@@ -1347,7 +1475,9 @@ class Booster:
 
             leafs, _ = lax.scan(step, jnp.zeros(n, jnp.int32), None,
                                 length=depth_cap)
-            g, h = obj.grad_hess(pred, y, w)
+            return leafs
+
+        def renew(tree, leafs, g, h):
             m = tree.leaf_value.shape[0]
             gs = jnp.zeros(m, jnp.float32).at[leafs].add(g)
             hs = jnp.zeros(m, jnp.float32).at[leafs].add(h)
@@ -1357,13 +1487,33 @@ class Booster:
                              decay * tree.leaf_value
                              + (1.0 - decay) * newton,
                              tree.leaf_value)
-            new_tree = tree._replace(leaf_value=vals)
-            return new_tree, pred + lr * vals[leafs]
+            return tree._replace(leaf_value=vals), vals[leafs]
 
-        pred = jnp.full(codes.shape[0], float(self.init_score_), jnp.float32)
+        @jax.jit
+        def one_tree(tree, pred):
+            g, h = obj.grad_hess(pred, y, w)
+            new_tree, delta = renew(tree, leaf_of(tree), g, h)
+            return new_tree, pred + lr * delta
+
+        @jax.jit
+        def one_round_mc(tree, pred):   # tree fields [K, M]; pred [n, K]
+            g, h = obj.grad_hess(pred, y, w)            # [n, K]
+            leafs = jax.vmap(leaf_of)(tree)             # [K, n]
+            new_tree, delta = jax.vmap(renew)(tree, leafs, g.T, h.T)
+            return new_tree, pred + lr * delta.T
+
+        if self._num_class > 1:
+            pred = jnp.broadcast_to(
+                jnp.asarray(self.init_score_, jnp.float32)[None, :],
+                (codes.shape[0], self._num_class))
+            step_fn = one_round_mc
+        else:
+            pred = jnp.full(codes.shape[0], float(self.init_score_),
+                            jnp.float32)
+            step_fn = one_tree
         new_trees = []
         for t in self.trees:
-            nt, pred = one_tree(t, pred)
+            nt, pred = step_fn(t, pred)
             new_trees.append(nt)
         out = _copy.copy(self)
         out.trees = new_trees
@@ -1378,6 +1528,50 @@ class Booster:
         out._pred_train = None
         out._bag = None
         return out
+
+    def trees_to_dataframe(self):
+        """Flat per-node pandas DataFrame (LightGBM ``trees_to_dataframe``):
+        one row per node with tree_index / node_depth / node_index /
+        children / parent / split_feature / split_gain / threshold /
+        decision_type / value / count, node names in LightGBM's
+        ``{tree}-S{split}`` / ``{tree}-L{leaf}`` convention."""
+        import pandas as pd
+
+        names = self.feature_name()
+        rows: List[Dict[str, Any]] = []
+
+        def walk(node: Dict[str, Any], tree_idx: int, depth: int,
+                 parent: Optional[str]) -> str:
+            is_leaf = "leaf_index" in node
+            nid = (f"{tree_idx}-L{node['leaf_index']}" if is_leaf
+                   else f"{tree_idx}-S{node['split_index']}")
+            row = {
+                "tree_index": tree_idx, "node_depth": depth,
+                "node_index": nid, "left_child": None, "right_child": None,
+                "parent_index": parent, "split_feature": None,
+                "split_gain": None, "threshold": None,
+                "decision_type": None,
+                "value": node.get("leaf_value"),
+                "count": int(node.get("leaf_count",
+                                      node.get("internal_count", 0))),
+            }
+            rows.append(row)
+            if not is_leaf:
+                row["split_feature"] = names[node["split_feature"]]
+                row["split_gain"] = node["split_gain"]
+                row["threshold"] = node["threshold"]
+                row["decision_type"] = node.get("decision_type", "<=")
+                row["value"] = None
+                row["left_child"] = walk(node["left_child"], tree_idx,
+                                         depth + 1, nid)
+                row["right_child"] = walk(node["right_child"], tree_idx,
+                                          depth + 1, nid)
+            return nid
+
+        dump = self.dump_model()
+        for ti, tinfo in enumerate(dump["tree_info"]):
+            walk(tinfo["tree_structure"], ti, 1, None)
+        return pd.DataFrame(rows)
 
     def dump_model(self, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> Dict[str, Any]:

@@ -34,6 +34,7 @@ from ..ops.histogram import compute_histograms, histogram_psum
 from ..ops.split import (
     BestSplit,
     SplitContext,
+    constrained_leaf_output,
     find_best_split,
     leaf_output,
 )
@@ -88,6 +89,11 @@ class _GrowState(NamedTuple):
     cand_rg: jnp.ndarray
     cand_rh: jnp.ndarray
     cand_rc: jnp.ndarray
+    # constrained child outputs + monotone ancestor bounds per node
+    cand_wl: jnp.ndarray        # f32[M]
+    cand_wr: jnp.ndarray        # f32[M]
+    bound_lo: jnp.ndarray       # f32[M]
+    bound_hi: jnp.ndarray       # f32[M]
     # dynamic growth state
     row_leaf: jnp.ndarray       # i32[n]
     n_nodes: jnp.ndarray        # i32[]
@@ -101,6 +107,38 @@ class _GrowState(NamedTuple):
 def _write(arr, idx, val, active):
     """Masked scalar write arr[idx] = val if active."""
     return arr.at[idx].set(jnp.where(active, val, arr[idx]))
+
+
+def _rand_bins_for_node(key, node_id, num_features, num_bins, col_bins):
+    """ExtraTrees: one random threshold position per feature per node
+    (upstream ``extra_trees``), drawn WITHIN each feature's own used-bin
+    range (``col_bins``, the per-training-column bin counts) so
+    low-cardinality features keep their full split chance — a global
+    [0, num_bins) draw would almost always land outside a binary feature's
+    single valid threshold.  Distinct stream from the bynode sampler.
+    """
+    k = jax.random.fold_in(jax.random.fold_in(key, 0x0EF7), node_id)
+    u = jax.random.uniform(k, (num_features,))
+    hi = (jnp.asarray(col_bins, jnp.float32) - 1.0 if col_bins is not None
+          else jnp.float32(max(num_bins - 1, 1)))
+    return jnp.floor(u * jnp.maximum(hi, 1.0)).astype(jnp.int32)
+
+
+def _mono_child_bounds(mono, feat, wl, wr, lo, hi):
+    """Basic-method monotone bounds for a split's children (upstream
+    LeafConstraintsBase 'basic'): descendants on the low side of an
+    increasing split are capped at the split's output mid-point, and vice
+    versa.  Shapes follow (feat, wl, wr, lo, hi) — scalar in the strict
+    grower, [W] vectors in the frontier grower."""
+    if mono is None:
+        return lo, hi, lo, hi
+    mval = mono[feat]
+    mid = 0.5 * (wl + wr)
+    hi_l = jnp.where(mval > 0, jnp.minimum(hi, mid), hi)
+    lo_l = jnp.where(mval < 0, jnp.maximum(lo, mid), lo)
+    lo_r = jnp.where(mval > 0, jnp.maximum(lo, mid), lo)
+    hi_r = jnp.where(mval < 0, jnp.minimum(hi, mid), hi)
+    return lo_l, hi_l, lo_r, hi_r
 
 
 def _fp_reduce_best(bs: BestSplit, axis_name: str,
@@ -229,6 +267,9 @@ def grow_tree(
     wave_width: int = 1,
     cat_info=None,
     fp_axis: Optional[str] = None,
+    mono=None,
+    extra_trees: bool = False,
+    col_bins=None,
 ) -> Tuple[Tree, jnp.ndarray]:
     """Grow one best-first tree.
 
@@ -249,6 +290,15 @@ def grow_tree(
         sampled set differs per node but is deterministic under the seed).
       axis_name: if set, per-shard histograms are psum-merged over this mesh
         axis — the data-parallel tree learner (SURVEY.md §2C).
+      mono: optional i32 ``[F]`` monotone constraints in {-1, 0, +1}
+        (upstream ``monotone_constraints``, basic method: violating splits
+        rejected, descendants clipped at the split's output mid-point).
+      extra_trees: ExtraTrees randomization (upstream ``extra_trees``) —
+        each node considers ONE random threshold per feature, drawn
+        deterministically from ``key`` and the node id within the
+        feature's own used-bin range (``col_bins``).
+      col_bins: optional i32 ``[F]`` per-training-column used-bin counts
+        (BinMapper.n_bins / EFB col_bins) bounding the extra_trees draw.
 
     Returns:
       (Tree, row_leaf) — row_leaf gives each training row's final leaf node id
@@ -264,7 +314,8 @@ def grow_tree(
             bins, stats, feature_mask, ctx, num_leaves, num_bins, max_depth,
             wave_width, ff_bynode=ff_bynode, key=key, axis_name=axis_name,
             hist_impl=hist_impl, row_chunk=row_chunk, hist_dtype=hist_dtype,
-            cat_info=cat_info)
+            cat_info=cat_info, mono=mono, extra_trees=extra_trees,
+            col_bins=col_bins)
     n, num_features = bins.shape
     capacity = 2 * num_leaves - 1
     max_depth = jnp.asarray(max_depth, jnp.int32)
@@ -284,6 +335,12 @@ def grow_tree(
                                    ff_bynode, num_features,
                                    base_mask=feature_mask)
 
+    def node_rand_bins(node_id):
+        if not extra_trees:
+            return None
+        return _rand_bins_for_node(key, node_id, num_features, num_bins,
+                                   col_bins)
+
     def hist_fn(seg_id, num_segments):
         # custom-vmap op: under fold/config/class batching, calls sharing
         # this binned matrix collapse into ONE wide-matmul pass instead of
@@ -298,10 +355,17 @@ def grow_tree(
     # ---- root -------------------------------------------------------------
     root_hist = hist_fn(jnp.zeros(n, jnp.int32), 1)[0]          # [F, B, 3]
     root_tot = jnp.sum(root_hist[0], axis=0)                     # (g, h, c)
+    # root output: unsmoothed (no parent), but still max_delta_step-capped
+    root_out = constrained_leaf_output(
+        root_tot[0], root_tot[1], root_tot[2],
+        ctx._replace(path_smooth=jnp.float32(0.0)),
+        jnp.float32(-jnp.inf), jnp.float32(jnp.inf), jnp.float32(0.0))
     # LightGBM convention: max_depth <= 0 means unlimited, so the root
     # (depth 0) is always splittable — if a limit exists it is >= 1.
     root_best = find_best_split(root_hist, ctx, node_feature_mask(0),
-                                jnp.bool_(True), cat_info)
+                                jnp.bool_(True), cat_info, mono=mono,
+                                parent_out=root_out,
+                                rand_bins=node_rand_bins(0))
     if fp_axis is not None:
         root_best = _fp_reduce_best(root_best, fp_axis, num_features)
 
@@ -313,8 +377,7 @@ def grow_tree(
         split_bin=full(0, jnp.int32),
         left=full(-1, jnp.int32),
         right=full(-1, jnp.int32),
-        leaf_value=full(0.0, jnp.float32).at[0].set(
-            leaf_output(root_tot[0], root_tot[1], ctx)),
+        leaf_value=full(0.0, jnp.float32).at[0].set(root_out),
         is_leaf=full(False, jnp.bool_).at[0].set(True),
         count=full(0.0, jnp.float32).at[0].set(root_tot[2]),
         split_gain=full(0.0, jnp.float32),
@@ -328,6 +391,10 @@ def grow_tree(
         cand_rg=full(0.0, jnp.float32).at[0].set(root_best.right_g),
         cand_rh=full(0.0, jnp.float32).at[0].set(root_best.right_h),
         cand_rc=full(0.0, jnp.float32).at[0].set(root_best.right_c),
+        cand_wl=full(0.0, jnp.float32).at[0].set(root_best.left_out),
+        cand_wr=full(0.0, jnp.float32).at[0].set(root_best.right_out),
+        bound_lo=full(-jnp.inf, jnp.float32),
+        bound_hi=full(jnp.inf, jnp.float32),
         row_leaf=jnp.zeros(n, jnp.int32),
         n_nodes=jnp.int32(1),
         n_leaves=jnp.int32(1),
@@ -372,14 +439,37 @@ def grow_tree(
                         jnp.where(row_leaf == nr, 1, 2)).astype(jnp.int32)
         hist2 = hist_fn(seg, 2)                                  # [2, F, B, 3]
 
-        # 4. candidate splits for the children (each child samples its own
+        # 4. child output bounds (monotone basic method).
+        wl_v, wr_v = st.cand_wl[leaf], st.cand_wr[leaf]
+        lo, hi = st.bound_lo[leaf], st.bound_hi[leaf]
+        lo_l, hi_l, lo_r, hi_r = _mono_child_bounds(mono, feat, wl_v, wr_v,
+                                                    lo, hi)
+
+        # 5. candidate splits for the children (each child samples its own
         # per-node feature subset when feature_fraction_bynode < 1).
         child_depth = st.depth[leaf] + 1
         depth_ok = (max_depth <= 0) | (child_depth < max_depth)
         child_masks = jnp.stack([node_feature_mask(nl), node_feature_mask(nr)])
-        bs: BestSplit = jax.vmap(
-            lambda h, m: find_best_split(h, ctx, m, depth_ok, cat_info))(
-                hist2, child_masks)
+        child_lo = jnp.stack([lo_l, lo_r])
+        child_hi = jnp.stack([hi_l, hi_r])
+        child_out = jnp.stack([wl_v, wr_v])
+        if extra_trees:
+            child_rand = jnp.stack([node_rand_bins(nl), node_rand_bins(nr)])
+
+            def score(h, m, lo_, hi_, po, rb):
+                return find_best_split(h, ctx, m, depth_ok, cat_info, mono,
+                                       lo_, hi_, po, rb)
+
+            bs: BestSplit = jax.vmap(score)(hist2, child_masks, child_lo,
+                                            child_hi, child_out, child_rand)
+        else:
+
+            def score(h, m, lo_, hi_, po):
+                return find_best_split(h, ctx, m, depth_ok, cat_info, mono,
+                                       lo_, hi_, po)
+
+            bs = jax.vmap(score)(hist2, child_masks, child_lo, child_hi,
+                                 child_out)
         if fp_axis is not None:
             bs = jax.vmap(
                 lambda b: _fp_reduce_best(b, fp_axis, num_features))(bs)
@@ -398,8 +488,8 @@ def grow_tree(
                        nl, True, active),
                 nr, True, active),
             leaf_value=_write(
-                _write(st.leaf_value, nl, leaf_output(lg, lh, ctx), active),
-                nr, leaf_output(rg, rh, ctx), active),
+                _write(st.leaf_value, nl, wl_v, active),
+                nr, wr_v, active),
             count=_write(_write(st.count, nl, lc, active), nr, rc, active),
             depth=_write(_write(st.depth, nl, child_depth, active),
                          nr, child_depth, active),
@@ -421,6 +511,14 @@ def grow_tree(
                            nr, bs.right_h[1], active),
             cand_rc=_write(_write(st.cand_rc, nl, bs.right_c[0], active),
                            nr, bs.right_c[1], active),
+            cand_wl=_write(_write(st.cand_wl, nl, bs.left_out[0], active),
+                           nr, bs.left_out[1], active),
+            cand_wr=_write(_write(st.cand_wr, nl, bs.right_out[0], active),
+                           nr, bs.right_out[1], active),
+            bound_lo=_write(_write(st.bound_lo, nl, lo_l, active),
+                            nr, lo_r, active),
+            bound_hi=_write(_write(st.bound_hi, nl, hi_l, active),
+                            nr, hi_r, active),
             row_leaf=row_leaf,
             n_nodes=st.n_nodes + jnp.where(active, 2, 0).astype(jnp.int32),
             n_leaves=st.n_leaves + jnp.where(active, 1, 0).astype(jnp.int32),
@@ -486,6 +584,11 @@ class _WaveState(NamedTuple):
     cand_rg: jnp.ndarray
     cand_rh: jnp.ndarray
     cand_rc: jnp.ndarray
+    # constrained child outputs + monotone ancestor bounds per node
+    cand_wl: jnp.ndarray
+    cand_wr: jnp.ndarray
+    bound_lo: jnp.ndarray
+    bound_hi: jnp.ndarray
     # frontier extras
     hist_cache: jnp.ndarray     # f32[num_leaves, F, B, 3] per-active-leaf
     node_slot: jnp.ndarray      # i32[M] node id -> hist_cache slot
@@ -514,6 +617,9 @@ def grow_tree_frontier(
     row_chunk: int = 131072,
     hist_dtype: str = "f32",
     cat_info=None,
+    mono=None,
+    extra_trees: bool = False,
+    col_bins=None,
 ) -> Tuple[Tree, jnp.ndarray]:
     """Best-first growth in WAVES: up to ``wave_width`` splits per data pass.
 
@@ -560,6 +666,12 @@ def grow_tree_frontier(
                                    ff_bynode, num_features,
                                    base_mask=feature_mask)
 
+    def node_rand_bins(node_id):
+        if not extra_trees:
+            return None
+        return _rand_bins_for_node(key, node_id, num_features, num_bins,
+                                   col_bins)
+
     def hist_fn(seg_id, num_segments):
         from ..ops.histogram import batched_histogram_op
 
@@ -571,8 +683,14 @@ def grow_tree_frontier(
     # ---- root -------------------------------------------------------------
     root_hist = hist_fn(jnp.zeros(n, jnp.int32), 1)[0]          # [F, B, 3]
     root_tot = jnp.sum(root_hist[0], axis=0)                     # (g, h, c)
+    root_out = constrained_leaf_output(
+        root_tot[0], root_tot[1], root_tot[2],
+        ctx._replace(path_smooth=jnp.float32(0.0)),
+        jnp.float32(-jnp.inf), jnp.float32(jnp.inf), jnp.float32(0.0))
     root_best = find_best_split(root_hist, ctx, node_feature_mask(0),
-                                jnp.bool_(True), cat_info)
+                                jnp.bool_(True), cat_info, mono=mono,
+                                parent_out=root_out,
+                                rand_bins=node_rand_bins(0))
 
     def full(val, dtype):
         return jnp.full((capacity,), val, dtype)
@@ -582,8 +700,7 @@ def grow_tree_frontier(
         split_bin=full(0, jnp.int32),
         left=full(-1, jnp.int32),
         right=full(-1, jnp.int32),
-        leaf_value=full(0.0, jnp.float32).at[0].set(
-            leaf_output(root_tot[0], root_tot[1], ctx)),
+        leaf_value=full(0.0, jnp.float32).at[0].set(root_out),
         is_leaf=full(False, jnp.bool_).at[0].set(True),
         count=full(0.0, jnp.float32).at[0].set(root_tot[2]),
         split_gain=full(0.0, jnp.float32),
@@ -597,6 +714,10 @@ def grow_tree_frontier(
         cand_rg=full(0.0, jnp.float32).at[0].set(root_best.right_g),
         cand_rh=full(0.0, jnp.float32).at[0].set(root_best.right_h),
         cand_rc=full(0.0, jnp.float32).at[0].set(root_best.right_c),
+        cand_wl=full(0.0, jnp.float32).at[0].set(root_best.left_out),
+        cand_wr=full(0.0, jnp.float32).at[0].set(root_best.right_out),
+        bound_lo=full(-jnp.inf, jnp.float32),
+        bound_hi=full(jnp.inf, jnp.float32),
         hist_cache=jnp.zeros((num_leaves, num_features, num_bins, 3),
                              jnp.float32).at[0].set(root_hist),
         node_slot=full(0, jnp.int32),
@@ -681,28 +802,48 @@ def grow_tree_frontier(
         node_slot = _scatter(st.node_slot, nl_r, left_slot, active_r)
         node_slot = _scatter(node_slot, nr_r, right_slot, active_r)
 
-        # 5. score candidates for all 2W fresh children from the cache.
+        # 5. child output bounds (monotone basic method, per splitting leaf).
+        pf = st.cand_feat[parent_r]
+        wl_w, wr_w = st.cand_wl[parent_r], st.cand_wr[parent_r]   # [W]
+        lo_w, hi_w = st.bound_lo[parent_r], st.bound_hi[parent_r]
+        lo_l, hi_l, lo_r, hi_r = _mono_child_bounds(mono, pf, wl_w, wr_w,
+                                                    lo_w, hi_w)
+
+        # 6. score candidates for all 2W fresh children from the cache.
         child_nodes = jnp.concatenate([nl_r, nr_r])       # [2W]
         child_hists = jnp.concatenate([left_hist, right_hist])
         child_depth1 = st.depth[parent_r] + 1             # [W]
         child_depth = jnp.concatenate([child_depth1, child_depth1])
         depth_ok = (max_depth <= 0) | (child_depth < max_depth)
         child_masks = jax.vmap(node_feature_mask)(child_nodes)
-        bs: BestSplit = jax.vmap(
-            lambda h, m, d: find_best_split(h, ctx, m, d, cat_info))(
-                child_hists, child_masks, depth_ok)
+        child_lo = jnp.concatenate([lo_l, lo_r])
+        child_hi = jnp.concatenate([hi_l, hi_r])
+        child_vals = jnp.concatenate([wl_w, wr_w])        # actual outputs
+        if extra_trees:
+            child_rand = jax.vmap(node_rand_bins)(child_nodes)
+
+            def score(h, m, d, lo_, hi_, po, rb):
+                return find_best_split(h, ctx, m, d, cat_info, mono,
+                                       lo_, hi_, po, rb)
+
+            bs: BestSplit = jax.vmap(score)(
+                child_hists, child_masks, depth_ok, child_lo, child_hi,
+                child_vals, child_rand)
+        else:
+
+            def score(h, m, d, lo_, hi_, po):
+                return find_best_split(h, ctx, m, d, cat_info, mono,
+                                       lo_, hi_, po)
+
+            bs = jax.vmap(score)(child_hists, child_masks, depth_ok,
+                                 child_lo, child_hi, child_vals)
         active_2 = jnp.concatenate([active_r, active_r])
 
-        # 6. commit: parents become internal, children become leaves.
-        pf = st.cand_feat[parent_r]
+        # 7. commit: parents become internal, children become leaves.
         pb = st.cand_bin[parent_r]
         pg = gains[parent_r]
-        lg, lh, lc = (st.cand_lg[parent_r], st.cand_lh[parent_r],
-                      st.cand_lc[parent_r])
-        rg, rh, rc = (st.cand_rg[parent_r], st.cand_rh[parent_r],
-                      st.cand_rc[parent_r])
-        child_vals = jnp.concatenate([leaf_output(lg, lh, ctx),
-                                      leaf_output(rg, rh, ctx)])
+        lc = st.cand_lc[parent_r]
+        rc = st.cand_rc[parent_r]
         child_cnts = jnp.concatenate([lc, rc])
 
         return st._replace(
@@ -729,6 +870,10 @@ def grow_tree_frontier(
             cand_rg=_scatter(st.cand_rg, child_nodes, bs.right_g, active_2),
             cand_rh=_scatter(st.cand_rh, child_nodes, bs.right_h, active_2),
             cand_rc=_scatter(st.cand_rc, child_nodes, bs.right_c, active_2),
+            cand_wl=_scatter(st.cand_wl, child_nodes, bs.left_out, active_2),
+            cand_wr=_scatter(st.cand_wr, child_nodes, bs.right_out, active_2),
+            bound_lo=_scatter(st.bound_lo, child_nodes, child_lo, active_2),
+            bound_hi=_scatter(st.bound_hi, child_nodes, child_hi, active_2),
             hist_cache=cache,
             node_slot=node_slot,
             row_leaf=row_leaf,

@@ -23,13 +23,22 @@ NEG_INF = -jnp.inf
 
 
 class SplitContext(NamedTuple):
-    """Traced regularization scalars for gain evaluation."""
+    """Traced regularization scalars for gain evaluation.
+
+    ``max_delta_step`` (<= 0 means unlimited) caps |leaf output| (upstream
+    ``max_delta_step``); ``path_smooth`` > 0 shrinks child outputs toward the
+    parent's value by ``n / (n + path_smooth)`` (upstream ``path_smooth``).
+    Both default off, in which case every output is the unconstrained optimum
+    and the gain reduces to the closed-form scan.
+    """
 
     lambda_l1: jnp.ndarray
     lambda_l2: jnp.ndarray
     min_data_in_leaf: jnp.ndarray
     min_sum_hessian: jnp.ndarray
     min_gain_to_split: jnp.ndarray
+    max_delta_step: jnp.ndarray = 0.0
+    path_smooth: jnp.ndarray = 0.0
 
     @staticmethod
     def from_params(p) -> "SplitContext":
@@ -39,6 +48,8 @@ class SplitContext(NamedTuple):
             min_data_in_leaf=jnp.float32(p.min_data_in_leaf),
             min_sum_hessian=jnp.float32(p.min_sum_hessian_in_leaf),
             min_gain_to_split=jnp.float32(p.min_gain_to_split),
+            max_delta_step=jnp.float32(p.max_delta_step),
+            path_smooth=jnp.float32(getattr(p, "path_smooth", 0.0)),
         )
 
 
@@ -57,6 +68,33 @@ def leaf_objective(sum_g, sum_h, ctx: SplitContext):
 def leaf_output(sum_g, sum_h, ctx: SplitContext):
     """Optimal leaf value: -ThresholdL1(G) / (H + lambda_l2)."""
     return -threshold_l1(sum_g, ctx.lambda_l1) / (sum_h + ctx.lambda_l2 + 1e-15)
+
+
+def leaf_objective_at(w, sum_g, sum_h, ctx: SplitContext):
+    """Objective contribution of a leaf FORCED to output ``w`` (upstream
+    ``GetLeafGainGivenOutput``): -2 * (G*w + (H + l2)/2 * w^2 + l1*|w|).
+
+    Equals :func:`leaf_objective` when ``w`` is the unconstrained optimum;
+    needed when monotone bounds / max_delta_step / path_smooth move the
+    output off the optimum."""
+    return -2.0 * (sum_g * w + 0.5 * (sum_h + ctx.lambda_l2) * w * w
+                   + ctx.lambda_l1 * jnp.abs(w))
+
+
+def constrained_leaf_output(sum_g, sum_h, count, ctx: SplitContext,
+                            lo, hi, parent_out):
+    """Leaf output under path smoothing, max_delta_step, and monotone
+    ancestor bounds ``[lo, hi]``.
+
+    Order matches upstream: smooth toward the parent first
+    (``w * n/(n+ps) + parent * ps/(n+ps)``), then clip to the intersection
+    of the monotone bounds and ``[-max_delta_step, +max_delta_step]``."""
+    w = leaf_output(sum_g, sum_h, ctx)
+    ps = ctx.path_smooth
+    factor = count / (count + jnp.maximum(ps, 1e-30))
+    w = jnp.where(ps > 0, w * factor + parent_out * (1.0 - factor), w)
+    cap = jnp.where(ctx.max_delta_step > 0, ctx.max_delta_step, jnp.inf)
+    return jnp.clip(w, jnp.maximum(lo, -cap), jnp.minimum(hi, cap))
 
 
 class CatInfo(NamedTuple):
@@ -84,6 +122,10 @@ class BestSplit(NamedTuple):
     right_g: jnp.ndarray
     right_h: jnp.ndarray
     right_c: jnp.ndarray
+    # child outputs under constraints (== unconstrained optimum when no
+    # monotone bounds / max_delta_step / path_smooth are active)
+    left_out: jnp.ndarray = None   # f32 []
+    right_out: jnp.ndarray = None  # f32 []
     # categorical subset splits (None when the dataset has no categoricals)
     cat: jnp.ndarray = None       # bool [] winner is a k-vs-rest cat split
     cat_mask: jnp.ndarray = None  # bool [B] bins that go LEFT
@@ -95,6 +137,11 @@ def find_best_split(
     feature_mask: jnp.ndarray,
     depth_ok: jnp.ndarray,
     cat_info=None,
+    mono=None,
+    bound_lo=None,
+    bound_hi=None,
+    parent_out=None,
+    rand_bins=None,
 ) -> BestSplit:
     """Scan one leaf's histogram for the best (feature, bin) split.
 
@@ -109,9 +156,23 @@ def find_best_split(
         trick, upstream ``FindBestThresholdCategorical``): bins sort by
         grad/(hess + cat_smooth), the usual prefix scan runs in that order,
         and the winning prefix becomes the left-child category SET.
+      mono: optional i32 ``[F]`` per-feature monotone constraints in
+        {-1, 0, +1} (upstream ``monotone_constraints``, basic method):
+        candidates whose child outputs violate the required ordering are
+        rejected; categorical subset splits are disqualified on constrained
+        features.
+      bound_lo / bound_hi: optional scalar output bounds inherited from
+        monotone ancestor splits (basic-method mid-point refinement); child
+        outputs are clipped into ``[bound_lo, bound_hi]``.
+      parent_out: optional scalar — this node's actual (constrained) output;
+        the gain baseline and the path-smoothing anchor.  Defaults to the
+        node's unconstrained optimum.
+      rand_bins: optional i32 ``[F]`` — when given (``extra_trees``), each
+        feature considers ONLY this one randomized threshold position
+        (upstream ExtraTrees mode; sklearn ExtraTreesRegressor semantics).
 
-    Returns BestSplit with child statistics so the grower can update node
-    state without touching the histogram again.
+    Returns BestSplit with child statistics AND constrained child outputs so
+    the grower can update node state without touching the histogram again.
     """
     cum = jnp.cumsum(hist, axis=1)                 # [F, B, 3] inclusive prefix
     total = cum[:, -1:, :]                         # [F, 1, 3]
@@ -119,9 +180,15 @@ def find_best_split(
     tg, th, tc = total[..., 0], total[..., 1], total[..., 2]
     rg, rh, rc = tg - lg, th - lh, tc - lc
 
-    parent_obj = leaf_objective(tg, th, ctx)       # [F, 1] (same for all f)
-    gain = (leaf_objective(lg, lh, ctx) + leaf_objective(rg, rh, ctx)
-            - parent_obj)                          # [F, B]
+    lo = jnp.float32(-jnp.inf) if bound_lo is None else bound_lo
+    hi = jnp.float32(jnp.inf) if bound_hi is None else bound_hi
+    p_out = (leaf_output(tg, th, ctx) if parent_out is None
+             else parent_out)                      # [F,1] or scalar
+    wl = constrained_leaf_output(lg, lh, lc, ctx, lo, hi, p_out)  # [F, B]
+    wr = constrained_leaf_output(rg, rh, rc, ctx, lo, hi, p_out)
+    parent_obj = leaf_objective_at(p_out, tg, th, ctx)  # [F, 1] or scalar
+    gain = (leaf_objective_at(wl, lg, lh, ctx)
+            + leaf_objective_at(wr, rg, rh, ctx) - parent_obj)  # [F, B]
 
     valid = (
         (lc >= ctx.min_data_in_leaf)
@@ -132,6 +199,12 @@ def find_best_split(
         & (feature_mask[:, None] > 0)
         & depth_ok
     )
+    if mono is not None:
+        m = mono[:, None].astype(wl.dtype)         # [F, 1]
+        valid &= (m == 0) | (m * (wr - wl) >= 0)
+    if rand_bins is not None:
+        pos_b = jnp.arange(hist.shape[1])[None, :]
+        valid &= pos_b == rand_bins[:, None]
     gain = jnp.where(valid, gain, NEG_INF)
 
     num_features, num_bins = gain.shape
@@ -144,7 +217,8 @@ def find_best_split(
             gain=gain.reshape(-1)[flat_idx], feature=feat, bin=bin_idx,
             left_g=lg[feat, bin_idx], left_h=lh[feat, bin_idx],
             left_c=lc[feat, bin_idx], right_g=rg[feat, bin_idx],
-            right_h=rh[feat, bin_idx], right_c=rc[feat, bin_idx])
+            right_h=rh[feat, bin_idx], right_c=rc[feat, bin_idx],
+            left_out=wl[feat, bin_idx], right_out=wr[feat, bin_idx])
 
     is_cat = cat_info.is_cat
     # Fisher ordering: bins ranked by grad/(hess + cat_smooth); empty bins
@@ -157,15 +231,21 @@ def find_best_split(
     raw_score = g_ / (h_ + cat_info.cat_smooth)
     pos = jnp.arange(num_bins)[None, :]
     ctx_cat = ctx._replace(lambda_l2=ctx.lambda_l2 + cat_info.cat_l2)
-    parent_cat = leaf_objective(tg, th, ctx_cat)
+    p_out_cat = (leaf_output(tg, th, ctx_cat) if parent_out is None
+                 else parent_out)
+    parent_cat = leaf_objective_at(p_out_cat, tg, th, ctx_cat)
 
     def scan_direction(order):
         hist_s = jnp.take_along_axis(hist, order[..., None], axis=1)
         cum_s = jnp.cumsum(hist_s, axis=1)
         slg, slh, slc = cum_s[..., 0], cum_s[..., 1], cum_s[..., 2]
         srg, srh, src = tg - slg, th - slh, tc - slc
-        gain_c = (leaf_objective(slg, slh, ctx_cat)
-                  + leaf_objective(srg, srh, ctx_cat) - parent_cat)
+        swl = constrained_leaf_output(slg, slh, slc, ctx_cat, lo, hi,
+                                      p_out_cat)
+        swr = constrained_leaf_output(srg, srh, src, ctx_cat, lo, hi,
+                                      p_out_cat)
+        gain_c = (leaf_objective_at(swl, slg, slh, ctx_cat)
+                  + leaf_objective_at(swr, srg, srh, ctx_cat) - parent_cat)
         valid_c = (
             (slc >= ctx.min_data_in_leaf)
             & (src >= ctx.min_data_in_leaf)
@@ -176,8 +256,15 @@ def find_best_split(
             & depth_ok
             & (pos < cat_info.max_cat_threshold)
         )
-        return jnp.where(valid_c, gain_c, NEG_INF), (slg, slh, slc, srg,
-                                                     srh, src)
+        if mono is not None:
+            # monotonicity is undefined over unordered category sets:
+            # constrained features take no subset splits (upstream rejects
+            # monotone_constraints on categorical columns at parse time)
+            valid_c &= mono[:, None] == 0
+        if rand_bins is not None:
+            valid_c &= pos == rand_bins[:, None]
+        return (jnp.where(valid_c, gain_c, NEG_INF),
+                (slg, slh, slc, srg, srh, src, swl, swr))
 
     order_asc = jnp.argsort(jnp.where(c_ > 0, raw_score, jnp.inf), axis=1)
     order_desc = jnp.argsort(jnp.where(c_ > 0, -raw_score, jnp.inf), axis=1)
@@ -209,4 +296,6 @@ def find_best_split(
         right_g=pick(stats_a[3], stats_d[3], rg),
         right_h=pick(stats_a[4], stats_d[4], rh),
         right_c=pick(stats_a[5], stats_d[5], rc),
+        left_out=pick(stats_a[6], stats_d[6], wl),
+        right_out=pick(stats_a[7], stats_d[7], wr),
         cat=cat_won, cat_mask=cat_mask)

@@ -78,7 +78,8 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                        num_bins: int, hist_impl: str = "auto",
                        row_chunk: int = 131072, is_rf: bool = False,
                        wave_width: int = 1, hist_dtype: str = "f32",
-                       goss_k_shard=None):
+                       goss_k_shard=None, mono_key=None,
+                       extra_trees: bool = False, nbins_key=None):
     """Build the jitted data-parallel round step for a mesh.
 
     Returns step(bins, y, w, bag, pred, feature_mask, hyper) ->
@@ -94,6 +95,10 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
     psum-merge as usual.
     """
     obj = _rebuild_objective(obj_key)
+    mono_arr = (None if mono_key is None
+                else jnp.asarray(mono_key, jnp.int32))
+    colb = (None if nbins_key is None
+            else jnp.asarray(nbins_key, jnp.int32))
 
     def step(bins, y, w, bag, pred, feature_mask, hyper: HyperScalars, key):
         g, h = obj.grad_hess(pred, y, w)
@@ -111,7 +116,8 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                 bins, y, w, bag, pred, feature_mask, hyper, key,
                 g, h, goss_k_shard, num_leaves, num_bins, hist_impl,
                 row_chunk, hist_dtype, wave_width, None, None,
-                axis_name=DATA_AXIS, sample_key=sample_key)
+                axis_name=DATA_AXIS, sample_key=sample_key,
+                mono=mono_arr, extra_trees=extra_trees, col_bins=colb)
             return tree, new_pred
         stats = jnp.stack([g * bag, h * bag, bag], axis=-1)
         tree, row_leaf = grow_tree(
@@ -119,7 +125,8 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
             hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
             key=key, axis_name=DATA_AXIS, hist_impl=hist_impl,
             row_chunk=row_chunk, hist_dtype=hist_dtype,
-            wave_width=wave_width)
+            wave_width=wave_width, mono=mono_arr, extra_trees=extra_trees,
+            col_bins=colb)
         shrink = jnp.where(is_rf, 1.0, hyper.learning_rate)
         new_pred = pred + shrink * tree.leaf_value[row_leaf]
         return tree, new_pred

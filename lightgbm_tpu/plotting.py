@@ -89,6 +89,59 @@ def plot_metric(booster_or_evals: Any, metric: Optional[str] = None,
     return ax
 
 
+def plot_split_value_histogram(booster, feature, bins=None, ax=None,
+                               width_coef: float = 0.8,
+                               title: str = "Split value histogram for "
+                                            "feature with @index/name@ "
+                                            "@feature@",
+                               xlabel: str = "Feature split value",
+                               ylabel: str = "Count", figsize=None,
+                               **kwargs):
+    """Histogram of a feature's split THRESHOLD values across the forest
+    (lightgbm.plot_split_value_histogram): where the model keeps cutting
+    this feature.  ``feature`` is an index or a feature name.
+
+    EFB note: splits on a multi-feature bundle column carry merged-axis
+    bin indices, not raw values (``bundled_bin_threshold`` in dump_model)
+    — those nodes are excluded rather than plotted on a wrong axis.
+    """
+    b = getattr(booster, "_Booster", booster)
+    names = b.feature_name()
+    if isinstance(feature, str):
+        fname = feature
+        if feature not in names:
+            raise ValueError(f"unknown feature name {feature!r}")
+    else:
+        fname = names[int(feature)]
+    values = []
+
+    def rec(node):
+        if "leaf_value" in node:
+            return
+        if names[node["split_feature"]] == fname and \
+                node.get("decision_type", "<=") == "<=" and \
+                not node.get("bundled_bin_threshold"):
+            values.append(float(node["threshold"]))
+        rec(node["left_child"])
+        rec(node["right_child"])
+
+    for info in b.dump_model()["tree_info"]:
+        rec(info["tree_structure"])
+    if not values:
+        raise ValueError(
+            f"feature {fname!r} is never used for numeric splits")
+    ax = _get_ax(ax, figsize)
+    counts, edges = np.histogram(values, bins=bins or "auto")
+    centers = 0.5 * (edges[:-1] + edges[1:])
+    ax.bar(centers, counts,
+           width=width_coef * (edges[1] - edges[0]), align="center")
+    ax.set_title(title.replace("@index/name@", "name")
+                 .replace("@feature@", str(fname)))
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    return ax
+
+
 def create_tree_digraph(booster, tree_index: int = 0,
                         show_info=None, precision: int = 3,
                         **kwargs) -> str:

@@ -115,3 +115,29 @@ def test_refit_weight_and_guardrails(data):
         b.refit(X, y, bogus_arg=1)
     # refit boosters are predict-only
     assert b_w.train_set is None
+
+
+def test_trees_to_dataframe():
+    import numpy as np
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 3)).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] + 0.2 * rng.normal(size=400)).astype(np.float32)
+    b = lgb.train({"objective": "regression", "verbosity": -1,
+                   "num_leaves": 7}, lgb.Dataset(X, label=y),
+                  num_boost_round=4)
+    df = b.trees_to_dataframe()
+    assert set(df.columns) >= {"tree_index", "node_depth", "node_index",
+                               "left_child", "right_child", "parent_index",
+                               "split_feature", "split_gain", "threshold",
+                               "decision_type", "value", "count"}
+    assert df.tree_index.nunique() == 4
+    # internal rows reference children that exist
+    ids = set(df.node_index)
+    internal = df[df.split_feature.notna()]
+    assert set(internal.left_child).issubset(ids)
+    assert set(internal.right_child).issubset(ids)
+    # leaves carry values, internals carry gains
+    assert df[df.value.notna()].left_child.isna().all()
+    assert (internal.split_gain >= 0).all()

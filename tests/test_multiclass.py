@@ -74,3 +74,37 @@ def test_multiclass_save_load_roundtrip(tmp_path, blobs):
     loaded = lgb.Booster(model_file=path)
     np.testing.assert_allclose(booster.predict(X[900:950]),
                                loaded.predict(X[900:950]), rtol=1e-5)
+
+
+def test_multiclass_pred_leaf(blobs):
+    X, y = blobs
+    dtrain = lgb.Dataset(X[:900], label=y[:900])
+    booster = lgb.train({"objective": "multiclass", "num_class": 3,
+                         "num_leaves": 7, "verbosity": 0},
+                        dtrain, num_boost_round=4)
+    leaves = booster.predict(X[:50], pred_leaf=True)
+    # LightGBM contract: [n, num_iteration * num_class], leaf ordinals
+    assert leaves.shape == (50, 4 * 3)
+    assert leaves.min() >= 0 and leaves.max() < 7
+    # rows landing in the same leaf get the same class scores
+    l2 = booster.predict(X[:50], pred_leaf=True, num_iteration=2)
+    assert l2.shape == (50, 2 * 3)
+    np.testing.assert_array_equal(l2, leaves[:, :6])
+
+
+def test_multiclass_refit(blobs):
+    X, y = blobs
+    dtrain = lgb.Dataset(X[:900], label=y[:900])
+    booster = lgb.train({"objective": "multiclass", "num_class": 3,
+                         "num_leaves": 7, "verbosity": 0},
+                        dtrain, num_boost_round=6)
+    ref = booster.refit(X[900:1400], y[900:1400], decay_rate=0.5)
+    # structure unchanged, values moved
+    for t0, t1 in zip(booster.trees, ref.trees):
+        np.testing.assert_array_equal(np.asarray(t0.split_feature),
+                                      np.asarray(t1.split_feature))
+        assert not np.allclose(np.asarray(t0.leaf_value),
+                               np.asarray(t1.leaf_value))
+    # refit on the training slice itself keeps accuracy in range
+    acc = np.mean(np.argmax(ref.predict(X[1400:]), axis=1) == y[1400:])
+    assert acc > 0.8, acc

@@ -1,0 +1,165 @@
+"""pred_contrib (exact path-dependent TreeSHAP) — ops/shap.py.
+
+Ground truth: brute-force Shapley enumeration over all feature subsets,
+with the conditional expectation defined EXACTLY as path-dependent
+TreeSHAP does (follow x for features in S, split by cover fractions
+otherwise).  Small feature counts keep 2^F enumeration cheap.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _tree_cond_expect(t, bins_row, subset):
+    """E[tree | features in `subset` follow x] under cover-fraction
+    weighting — the defining recursion of path-dependent TreeSHAP."""
+    def rec(node):
+        if t["is_leaf"][node]:
+            return float(t["leaf_value"][node])
+        feat = int(t["split_feature"][node])
+        left, right = int(t["left"][node]), int(t["right"][node])
+        if feat in subset:
+            code = int(bins_row[feat])
+            if t.get("is_cat_split") is not None and t["is_cat_split"][node]:
+                go_left = bool(t["cat_mask"][node][code])
+            else:
+                go_left = code <= int(t["split_bin"][node])
+            return rec(left if go_left else right)
+        denom = max(float(t["count"][node]), 1e-12)
+        wl = float(t["count"][left]) / denom
+        wr = float(t["count"][right]) / denom
+        return wl * rec(left) + wr * rec(right)
+
+    return rec(0)
+
+
+def _brute_shap(t, bins_row, num_features):
+    """Exact Shapley values by subset enumeration (2^F)."""
+    from itertools import combinations
+    from math import factorial
+
+    phi = np.zeros(num_features + 1)
+    feats = list(range(num_features))
+    F = num_features
+    for i in feats:
+        others = [f for f in feats if f != i]
+        for k in range(F):
+            for S in combinations(others, k):
+                wgt = (factorial(k) * factorial(F - k - 1)) / factorial(F)
+                gain = (_tree_cond_expect(t, bins_row, set(S) | {i})
+                        - _tree_cond_expect(t, bins_row, set(S)))
+                phi[i] += wgt * gain
+    phi[F] = _tree_cond_expect(t, bins_row, set())
+    return phi
+
+
+def _tree_np(booster, idx=0):
+    from lightgbm_tpu.models.tree import Tree
+
+    t = booster.trees[idx]
+    return {f: (None if getattr(t, f) is None else np.asarray(getattr(t, f)))
+            for f in Tree._fields}
+
+
+@pytest.fixture(scope="module")
+def shap_model():
+    rng = np.random.default_rng(5)
+    n, F = 2000, 4
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = (2 * X[:, 0] - X[:, 1] + X[:, 2] * (X[:, 3] > 0)
+         + 0.1 * rng.normal(size=n)).astype(np.float32)
+    ds = lgb.Dataset(X, label=y)
+    b = lgb.train({"objective": "regression", "verbosity": -1,
+                   "num_leaves": 15}, ds, num_boost_round=12)
+    return X, y, ds, b
+
+
+def test_matches_bruteforce_single_tree(shap_model):
+    X, y, ds, b = shap_model
+    t = _tree_np(b, 0)
+    codes = ds.bin_mapper.transform(X[:16])
+    contrib = b.predict(X[:16], pred_contrib=True, num_iteration=1)
+    lr = b.params.learning_rate
+    init = float(np.float32(b.init_score_))
+    for r in range(16):
+        want = _brute_shap(t, codes[r], X.shape[1])
+        got = contrib[r].astype(np.float64)
+        # tree contributions scale by lr; bias additionally carries init
+        np.testing.assert_allclose(got[:-1], lr * want[:-1],
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(got[-1], lr * want[-1] + init,
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_checksum_full_forest(shap_model):
+    X, y, ds, b = shap_model
+    contrib = b.predict(X[:200], pred_contrib=True)
+    raw = b.predict(X[:200], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_truncation_and_shape(shap_model):
+    X, y, ds, b = shap_model
+    c5 = b.predict(X[:50], pred_contrib=True, num_iteration=5)
+    raw5 = b.predict(X[:50], raw_score=True, num_iteration=5)
+    assert c5.shape == (50, X.shape[1] + 1)
+    np.testing.assert_allclose(c5.sum(axis=1), raw5, rtol=1e-4, atol=1e-4)
+
+
+def test_binary_objective_raw_space():
+    rng = np.random.default_rng(9)
+    n, F = 1500, 4
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.3 * rng.normal(size=n)
+         > 0).astype(np.float32)
+    ds = lgb.Dataset(X, label=y)
+    b = lgb.train({"objective": "binary", "verbosity": -1}, ds,
+                  num_boost_round=15)
+    contrib = b.predict(X[:100], pred_contrib=True)
+    raw = b.predict(X[:100], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_multiclass_contrib_shape():
+    rng = np.random.default_rng(2)
+    n, F, K = 900, 4, 3
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = rng.integers(0, K, n).astype(np.float32)
+    ds = lgb.Dataset(X, label=y)
+    b = lgb.train({"objective": "multiclass", "num_class": K,
+                   "verbosity": -1}, ds, num_boost_round=5)
+    contrib = b.predict(X[:40], pred_contrib=True)
+    assert contrib.shape == (40, K * (F + 1))
+    raw = b.predict(X[:40], raw_score=True)           # [n, K]
+    sums = contrib.reshape(40, K, F + 1).sum(axis=2)
+    np.testing.assert_allclose(sums, raw, rtol=1e-4, atol=1e-4)
+
+
+def test_categorical_split_contrib():
+    rng = np.random.default_rng(4)
+    n = 2000
+    cat = rng.integers(0, 6, n)
+    x1 = rng.normal(size=n)
+    X = np.column_stack([cat, x1]).astype(np.float32)
+    effect = np.asarray([2.0, -1.0, 0.5, 3.0, -2.0, 0.0])
+    y = (effect[cat] + 0.2 * x1 + 0.1 * rng.normal(size=n)).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    b = lgb.train({"objective": "regression", "verbosity": -1,
+                   "num_leaves": 15}, ds, num_boost_round=10)
+    contrib = b.predict(X[:100], pred_contrib=True)
+    raw = b.predict(X[:100], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw,
+                               rtol=1e-4, atol=1e-4)
+    # the categorical feature drives the target -> dominant attribution
+    assert np.abs(contrib[:, 0]).mean() > np.abs(contrib[:, 1]).mean()
+
+
+def test_sklearn_wrapper_pred_contrib(shap_model):
+    X, y, ds, b = shap_model
+    reg = lgb.LGBMRegressor(n_estimators=8, verbosity=-1).fit(X, y)
+    c = reg.predict(X[:20], pred_contrib=True)
+    assert c.shape == (20, X.shape[1] + 1)
