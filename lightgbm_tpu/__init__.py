@@ -29,6 +29,7 @@ from .callback import (
     early_stopping,
     log_evaluation,
     record_evaluation,
+    reset_parameter,
 )
 from .engine import CVBooster, CVResult, cv, train
 from .models.gbdt import Booster
@@ -48,6 +49,7 @@ __all__ = [
     "log_evaluation",
     "parse_params",
     "record_evaluation",
+    "reset_parameter",
     "train",
 ]
 

@@ -134,3 +134,33 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
 
     _callback.order = 30
     return _callback
+
+
+def reset_parameter(**kwargs) -> Callable:
+    """Per-iteration parameter schedule (LightGBM ``reset_parameter``):
+    each keyword is either a list of length ``num_boost_round`` or a
+    ``callable(iteration) -> value``.  Runs BEFORE each boosting round
+    (``before_iteration``), so round ``i`` trains with the scheduled
+    values — the classic use is learning-rate decay::
+
+        lgb.train(params, ds, 100,
+                  callbacks=[lgb.reset_parameter(
+                      learning_rate=lambda i: 0.1 * 0.99 ** i)])
+
+    Only trace-dynamic parameters (learning_rate, lambda_l1/l2,
+    min_data_in_leaf, fractions, ...) can change between rounds; resetting
+    a shape-static parameter (num_leaves, max_bin, objective) raises.
+    """
+
+    def _callback(env: CallbackEnv) -> None:
+        new = {}
+        for key, spec in kwargs.items():
+            value = (spec(env.iteration - env.begin_iteration)
+                     if callable(spec) else spec[env.iteration
+                                                - env.begin_iteration])
+            new[key] = value
+        env.model.reset_parameter(new)
+
+    _callback.before_iteration = True
+    _callback.order = 10
+    return _callback

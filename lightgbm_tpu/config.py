@@ -109,6 +109,9 @@ _ALIASES: Dict[str, str] = {
     "monotone_constraining_method": "monotone_constraints_method",
     "mc_method": "monotone_constraints_method",
     "path_smooth": "path_smooth",
+    "linear_tree": "linear_tree",
+    "linear_trees": "linear_tree",
+    "linear_lambda": "linear_lambda",
     "grow_policy": "grow_policy",
     "growth_policy": "grow_policy",
     "early_stopping_round": "early_stopping_round",
@@ -276,6 +279,7 @@ _FRAMEWORK_KEYS = {
     "cv_segment_rounds",   # fused-cv rounds per device dispatch
     "fobj",                # custom objective callable
     "wave_width",          # frontier grower: max splits per histogram pass
+    "linear_k",            # linear_tree: max path features per leaf model
 }
 
 _BOOSTING_ALIASES: Dict[str, str] = {
@@ -318,6 +322,10 @@ class Params:
     monotone_constraints: Optional[List[int]] = None
     monotone_constraints_method: str = "basic"
     path_smooth: float = 0.0
+    # linear leaves (upstream ``linear_tree``): each leaf fits a ridge
+    # model over (the first ``linear_k``, a framework key) path features
+    linear_tree: bool = False
+    linear_lambda: float = 0.0
     # leafwise = strict LightGBM best-first (one split per histogram pass);
     # frontier = wave growth with histogram subtraction (up to wave_width
     # splits per pass — the large-data fast path); auto picks by data size.
@@ -515,6 +523,18 @@ def _validate(p: Params) -> None:
                 "conservative")
     if p.path_smooth < 0:
         raise ValueError(f"path_smooth must be >= 0, got {p.path_smooth}")
+    if p.linear_tree:
+        if p.linear_lambda < 0:
+            raise ValueError(
+                f"linear_lambda must be >= 0, got {p.linear_lambda}")
+        if p.boosting != "gbdt":
+            raise NotImplementedError(
+                f"linear_tree supports boosting='gbdt' only "
+                f"(got {p.boosting!r})")
+        if p.objective in ("multiclass", "multiclassova", "lambdarank"):
+            raise NotImplementedError(
+                f"linear_tree with objective={p.objective!r} is not "
+                "supported yet")
     if p.boosting == "rf":
         if p.bagging_freq <= 0 or not (0.0 < p.bagging_fraction < 1.0):
             # LightGBM requires bagging for rf mode; default to sklearn-ish bootstrap

@@ -93,7 +93,8 @@ def train(
                                   first_metric_only=p.first_metric_only,
                                   verbose=p.verbosity > 0))
     if verbose_eval not in (None, False) and not any(
-            getattr(c, "order", None) == 10 for c in cbs):
+            getattr(c, "order", None) == 10
+            and not getattr(c, "before_iteration", False) for c in cbs):
         period = 1 if verbose_eval is True else int(verbose_eval)
         cbs.append(log_evaluation(period))
     if evals_result is not None:
@@ -113,9 +114,17 @@ def train(
         booster.update_many(num_boost_round)
         return booster
 
+    cbs_before = [c for c in cbs if getattr(c, "before_iteration", False)]
+    cbs_after = [c for c in cbs if not getattr(c, "before_iteration", False)]
+
     results: List = []
     try:
         for i in range(num_boost_round):
+            for cb in cbs_before:  # e.g. reset_parameter schedules
+                cb(CallbackEnv(model=booster, params=booster.params,
+                               iteration=i, begin_iteration=0,
+                               end_iteration=num_boost_round,
+                               evaluation_result_list=[]))
             booster.update()
             results = []
             if booster._valid or eval_training or cbs:
@@ -125,7 +134,7 @@ def train(
             env = CallbackEnv(model=booster, params=p, iteration=i,
                               begin_iteration=0, end_iteration=num_boost_round,
                               evaluation_result_list=results)
-            for cb in cbs:
+            for cb in cbs_after:
                 cb(env)
     except EarlyStopException as e:
         booster.best_iteration = e.best_iteration
@@ -322,7 +331,8 @@ def cv(
                                   first_metric_only=p.first_metric_only,
                                   verbose=p.verbosity > 0))
     if verbose_eval not in (None, False) and not any(
-            getattr(c, "order", None) == 10 for c in cbs):
+            getattr(c, "order", None) == 10
+            and not getattr(c, "before_iteration", False) for c in cbs):
         period = 1 if verbose_eval is True else int(verbose_eval)
         cbs.append(log_evaluation(period, show_stdv=show_stdv))
     cbs.sort(key=lambda c: getattr(c, "order", 50))
@@ -331,9 +341,17 @@ def cv(
     history: Dict[str, List[float]] = {}
     agg_history: List[List] = []
 
+    cv_before = [c for c in cbs if getattr(c, "before_iteration", False)]
+    cbs = [c for c in cbs if not getattr(c, "before_iteration", False)]
+
     try:
         for i in range(num_boost_round):
             for b in cvb.boosters:
+                for cb in cv_before:  # reset_parameter schedules, per fold
+                    cb(CallbackEnv(model=b, params=b.params, iteration=i,
+                                   begin_iteration=0,
+                                   end_iteration=num_boost_round,
+                                   evaluation_result_list=[]))
                 b.update()
             # aggregate fold metrics
             per_metric: Dict[tuple, List[float]] = {}

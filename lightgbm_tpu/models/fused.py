@@ -184,7 +184,8 @@ def fused_cv_eligible(p: Params, feval, callbacks, train_set=None) -> bool:
         return False
     if p.boosting not in ("gbdt",):
         return False
-    if p.monotone_constraints is not None or p.extra_trees:
+    if p.monotone_constraints is not None or p.extra_trees \
+            or p.linear_tree:
         # constrained/randomized split selection needs the per-booster
         # mono_key plumbing; the fused batch program does not trace it yet
         return False
@@ -245,6 +246,7 @@ def run_fused_cv_batch(
         other_rate=rep([p.other_rate for p in param_list]),
         max_delta_step=rep([p.max_delta_step for p in param_list]),
         path_smooth=rep([p.path_smooth for p in param_list]),
+        linear_lambda=rep([p.linear_lambda for p in param_list]),
     )
     bag_frac_b = rep([p.bagging_fraction for p in param_list])
     ff_b = rep([p.feature_fraction for p in param_list])

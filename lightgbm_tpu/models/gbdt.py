@@ -47,6 +47,7 @@ class HyperScalars(NamedTuple):
     other_rate: jnp.ndarray      # GOSS b
     max_delta_step: jnp.ndarray = 0.0   # |leaf output| cap (<=0 = off)
     path_smooth: jnp.ndarray = 0.0      # child-output smoothing (0 = off)
+    linear_lambda: jnp.ndarray = 0.0    # linear-leaf ridge (linear_tree)
 
     @staticmethod
     def from_params(p: Params) -> "HyperScalars":
@@ -63,6 +64,7 @@ class HyperScalars(NamedTuple):
             other_rate=jnp.float32(p.other_rate),
             max_delta_step=jnp.float32(p.max_delta_step),
             path_smooth=jnp.float32(p.path_smooth),
+            linear_lambda=jnp.float32(p.linear_lambda),
         )
 
     def ctx(self) -> SplitContext:
@@ -238,7 +240,8 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
               wave_width: int = 1, goss_k: Optional[Tuple[int, int]] = None,
               cat_key: Optional[tuple] = None,
               mono_key: Optional[tuple] = None, extra_trees: bool = False,
-              nbins_key: Optional[tuple] = None):
+              nbins_key: Optional[tuple] = None,
+              linear_k: Optional[int] = None):
     """goss_k: static (k_top, k_other) row counts enabling the compacted
     GOSS path; None = plain gbdt/rf.  cat_key: static categorical-split
     configuration (see _build_cat_info).  mono_key: static per-feature
@@ -305,6 +308,35 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                 mono=mono_arr, extra_trees=extra_trees, col_bins=colb)
 
         return round_fn_goss
+
+    if linear_k is not None:
+        from .tree import fit_linear_leaves
+
+        @jax.jit
+        def round_fn_linear(bins, y, w, bag, pred, feature_mask,
+                            hyper: HyperScalars, key, xraw):
+            """linear_tree round: constant-leaf growth on binned codes,
+            then every leaf refits a ridge model over its path features on
+            the RAW values (tree.fit_linear_leaves) — the Newton constant
+            remains the fallback for degenerate leaves."""
+            g, h = obj.grad_hess(pred, y, w)
+            stats = jnp.stack(
+                [g * bag, h * bag, (bag > 0).astype(jnp.float32)], axis=-1)
+            tree, row_leaf = grow_tree(
+                bins, stats, feature_mask, hyper.ctx(), num_leaves,
+                num_bins, hyper.max_depth,
+                ff_bynode=hyper.feature_fraction_bynode,
+                key=key, hist_impl=hist_impl, row_chunk=row_chunk,
+                hist_dtype=hist_dtype, wave_width=wave_width,
+                cat_info=_build_cat_info(cat_key, bins.shape[1]),
+                mono=mono_arr, extra_trees=extra_trees, col_bins=colb)
+            tree, delta = fit_linear_leaves(
+                tree, row_leaf, xraw, g, h, bag, hyper.linear_lambda,
+                linear_k, row_chunk)
+            new_pred = pred + hyper.learning_rate * delta
+            return tree, new_pred
+
+        return round_fn_linear
 
     @jax.jit
     def round_fn(bins, y, w, bag, pred, feature_mask, hyper: HyperScalars,
@@ -436,6 +468,39 @@ def _tree_pred_fn(depth_cap: int, num_class: int = 1):
 
 
 @functools.lru_cache(maxsize=None)
+def _linear_tree_pred_fn(depth_cap: int):
+    """pred += shrink * (leaf_const + coef . raw_pathfeats) for ONE linear
+    tree (traversal on binned codes, evaluation on raw values)."""
+
+    @jax.jit
+    def add(pred, tree, bins, xraw, shrink):
+        n = bins.shape[0]
+        b32 = bins.astype(jnp.int32)
+
+        def step(node, _):
+            feat = tree.split_feature[node]
+            thr = tree.split_bin[node]
+            code = jnp.take_along_axis(b32, feat[:, None], axis=1)[:, 0]
+            go_left = code <= thr
+            if tree.is_cat_split is not None:
+                go_left = jnp.where(tree.is_cat_split[node],
+                                    tree.cat_mask[node, code], go_left)
+            nxt = jnp.where(go_left, tree.left[node], tree.right[node])
+            return jnp.where(tree.is_leaf[node], node, nxt), None
+
+        node, _ = lax.scan(step, jnp.zeros(n, jnp.int32), None,
+                           length=depth_cap)
+        feats = tree.linear_feat[node]                    # [n, K]
+        xg = jnp.take_along_axis(xraw, jnp.maximum(feats, 0), axis=1)
+        xg = jnp.where((feats >= 0) & jnp.isfinite(xg), xg, 0.0)
+        val = tree.leaf_value[node] + jnp.sum(
+            tree.linear_coef[node] * xg, axis=1)
+        return pred + shrink * val
+
+    return add
+
+
+@functools.lru_cache(maxsize=None)
 def _eval_fn(obj_key: tuple, metric_names: tuple, metric_cfg: tuple):
     obj = _rebuild_objective(obj_key)
     p = Params(alpha=metric_cfg[0]) if metric_cfg else Params()
@@ -554,6 +619,10 @@ class Booster:
                 ds.row_mask.shape, self.init_score_, jnp.float32)
         self._bag = ds.row_mask
         self._hyper = HyperScalars.from_params(p)
+        # predict-time shrinkage base: stored leaf values are normalized to
+        # THIS rate, so reset_parameter learning-rate schedules stay exact
+        # (round i's tree is rescaled by lr_i / base at append time)
+        self._base_lr = float(p.learning_rate)
         self._obj_key = _objective_static_key(self.obj, p)
         self._num_bins = ds.num_bins
         self._w_eff = ds.w  # 0 on padding rows already
@@ -573,6 +642,10 @@ class Booster:
             self._nbins_key = tuple(int(x) for x in colb)
         else:
             self._nbins_key = None
+        self._xraw = None
+        self._linear_k = None
+        if p.linear_tree:
+            self._setup_linear_tree()
         self._dp_mesh = None
         self._fp_mesh = None
         if p.tree_learner == "feature":
@@ -619,6 +692,40 @@ class Booster:
                 train_mc.append(0)
         return tuple(train_mc)
 
+    @staticmethod
+    def _raw_to_device(raw, n_pad: int):
+        """Raw feature matrix -> padded f32 device array (linear_tree)."""
+        from ..dataset import _to_2d_float_array
+
+        X = _to_2d_float_array(raw).astype(np.float32)
+        if X.shape[0] < n_pad:
+            X = np.concatenate(
+                [X, np.zeros((n_pad - X.shape[0], X.shape[1]), np.float32)])
+        return jnp.asarray(X)
+
+    def _setup_linear_tree(self) -> None:
+        """Device-resident raw feature matrix for linear leaves (upstream
+        ``linear_tree``): the ridge fit and linear prediction read RAW
+        values, which the binned pipeline otherwise never ships to the
+        device.  EFB must be off (a merged bundle column has no single raw
+        value; upstream LightGBM likewise forbids linear trees with EFB).
+        """
+        ds = self.train_set
+        p = self.params
+        if ds.bin_mapper.bundler is not None:
+            raise ValueError(
+                "linear_tree with EFB bundling is not supported; construct "
+                "the Dataset with params={'enable_bundle': False}")
+        raw = ds.raw_data
+        if raw is None or isinstance(raw, str):
+            raise ValueError(
+                "linear_tree needs the raw feature values: keep "
+                "free_raw_data=False and build the Dataset from an "
+                "in-memory matrix (not a saved binary)")
+        self._xraw = self._raw_to_device(raw, int(ds.row_mask.shape[0]))
+        self._linear_k = max(1, min(int(p.extra.get("linear_k", 8)),
+                                    int(ds.num_feature_)))
+
     def _maybe_setup_dp(self) -> None:
         """Shard the training arrays over the local device mesh when the
         user asks for a parallel tree learner (LightGBM ``tree_learner=data``
@@ -632,14 +739,14 @@ class Booster:
         import warnings
 
         p = self.params
-        if (self._num_class > 1 or p.boosting == "dart"
+        if (p.boosting == "dart" or p.linear_tree
                 or getattr(self.obj, "needs_group", False)
                 or getattr(self.obj, "renew_alpha", None) is not None
                 or self._cat_key is not None):
             warnings.warn(
                 f"tree_learner='{p.tree_learner}' currently supports "
-                "single-output non-ranking gbdt/rf/goss boosting; training "
-                "serially", stacklevel=3)
+                "non-ranking gbdt/rf/goss boosting without leaf renewal "
+                "or categorical splits; training serially", stacklevel=3)
             return
         n_pad = int(self.train_set.row_mask.shape[0])
         n_dev = len(jax.devices())
@@ -670,6 +777,7 @@ class Booster:
 
         p = self.params
         if (self._num_class > 1 or p.boosting in ("goss", "dart")
+                or p.linear_tree
                 or getattr(self.obj, "needs_group", False)
                 or getattr(self.obj, "renew_alpha", None) is not None
                 or self._cat_key is not None
@@ -760,7 +868,9 @@ class Booster:
                 "this Dataset; rebuild the Dataset with "
                 "reference=<original training Dataset> (or identical data) "
                 "before continuing training")
-        scale = jnp.float32(prev.params.learning_rate / p.learning_rate)
+        prev_lr = float(getattr(prev, "_base_lr",
+                                prev.params.learning_rate))
+        scale = jnp.float32(prev_lr / self._base_lr)
         self.trees = [t._replace(leaf_value=t.leaf_value * scale)
                       for t in prev.trees]
         self._iter = len(self.trees)
@@ -785,7 +895,7 @@ class Booster:
                              np.float32)])
                 self._pred_train = self._pred_train + jnp.asarray(base)
         add = _tree_pred_fn(self._depth_cap, self._num_class)
-        shrink = jnp.float32(p.learning_rate)
+        shrink = jnp.float32(self._base_lr)
         for tree in self.trees:
             self._pred_train = add(self._pred_train, tree, ds.X_binned,
                                    shrink)
@@ -872,7 +982,8 @@ class Booster:
                 int(p.extra.get("row_chunk", 131072)), p.boosting == "rf",
                 resolve_wave_width(p, eff_rows),
                 resolve_hist_dtype(p, eff_rows), goss_k_shard,
-                self._mono_key, p.extra_trees, self._nbins_key)
+                self._mono_key, p.extra_trees, self._nbins_key,
+                self._num_class)
             tree, new_pred = fn(self._dp_bins, self._dp_y, self._dp_w,
                                 self._bag, self._pred_train, fmask,
                                 self._hyper, round_key)
@@ -884,21 +995,41 @@ class Booster:
                            resolve_hist_dtype(p, eff_rows),
                            resolve_wave_width(p, eff_rows), goss_k,
                            self._cat_key, self._mono_key, p.extra_trees,
-                           self._nbins_key)
-            tree, new_pred = fn(ds.X_binned, ds.y, self._w_eff, self._bag,
-                                self._pred_train, fmask, self._hyper,
-                                round_key)
+                           self._nbins_key, self._linear_k)
+            if self._linear_k is not None:
+                tree, new_pred = fn(ds.X_binned, ds.y, self._w_eff,
+                                    self._bag, self._pred_train, fmask,
+                                    self._hyper, round_key, self._xraw)
+            else:
+                tree, new_pred = fn(ds.X_binned, ds.y, self._w_eff,
+                                    self._bag, self._pred_train, fmask,
+                                    self._hyper, round_key)
         if p.boosting != "rf":
             self._pred_train = new_pred
+        if p.boosting != "rf" and p.learning_rate != self._base_lr:
+            # reset_parameter schedule: bake lr_i/base into stored values so
+            # the uniform predict-time shrink (base) reproduces lr_i exactly
+            scale = jnp.float32(p.learning_rate / self._base_lr)
+            tree = tree._replace(
+                leaf_value=tree.leaf_value * scale,
+                linear_coef=(None if tree.linear_coef is None
+                             else tree.linear_coef * scale))
         self.trees.append(tree)
         self._forest_cache = None
         # incremental valid-set predictions
-        shrink = 1.0 if p.boosting == "rf" else p.learning_rate
-        add_tree = _tree_pred_fn(p.num_leaves, self._num_class)
-        for idx, (name, vds, vpred) in enumerate(self._valid):
-            self._valid[idx] = (
-                name, vds, add_tree(vpred, tree, vds.X_binned,
-                                    jnp.float32(shrink)))
+        shrink = 1.0 if p.boosting == "rf" else self._base_lr
+        if self._linear_k is not None:
+            add_lin = _linear_tree_pred_fn(self._depth_cap)
+            for idx, (name, vds, vpred) in enumerate(self._valid):
+                self._valid[idx] = (
+                    name, vds, add_lin(vpred, tree, vds.X_binned,
+                                       vds._xraw_dev, jnp.float32(shrink)))
+        else:
+            add_tree = _tree_pred_fn(p.num_leaves, self._num_class)
+            for idx, (name, vds, vpred) in enumerate(self._valid):
+                self._valid[idx] = (
+                    name, vds, add_tree(vpred, tree, vds.X_binned,
+                                        jnp.float32(shrink)))
         self._iter += 1
         return False
 
@@ -910,6 +1041,7 @@ class Booster:
                 and getattr(self, "_dp_mesh", None) is None
                 and getattr(self, "_fp_mesh", None) is None
                 and p.boosting in ("gbdt", "rf", "goss")
+                and not p.linear_tree
                 and not self._valid)
 
     def update_many(self, k: int) -> None:
@@ -1162,10 +1294,25 @@ class Booster:
             vpred = jnp.full(data.row_mask.shape, self.init_score_,
                              jnp.float32)
         # replay existing trees (valid sets are usually added before round 0)
-        shrink = 1.0 if self.params.boosting == "rf" else self.params.learning_rate
-        add_tree = _tree_pred_fn(self._depth_cap, k)
-        for tree in self.trees:
-            vpred = add_tree(vpred, tree, data.X_binned, jnp.float32(shrink))
+        shrink = (1.0 if self.params.boosting == "rf"
+                  else getattr(self, "_base_lr", self.params.learning_rate))
+        if getattr(self, "_linear_k", None) is not None:
+            raw = data.raw_data
+            if raw is None or isinstance(raw, str):
+                raise ValueError(
+                    "linear_tree valid sets need raw feature values "
+                    "(free_raw_data=False, in-memory matrix)")
+            data._xraw_dev = self._raw_to_device(
+                raw, int(data.row_mask.shape[0]))
+            add_lin = _linear_tree_pred_fn(self._depth_cap)
+            for tree in self.trees:
+                vpred = add_lin(vpred, tree, data.X_binned, data._xraw_dev,
+                                jnp.float32(shrink))
+        else:
+            add_tree = _tree_pred_fn(self._depth_cap, k)
+            for tree in self.trees:
+                vpred = add_tree(vpred, tree, data.X_binned,
+                                 jnp.float32(shrink))
         self._valid.append((name, data, vpred))
         return self
 
@@ -1252,8 +1399,25 @@ class Booster:
                     leaves.append(np.asarray(ordinal[node]))
             return np.stack(leaves, axis=1)
         if pred_contrib:
+            if self.trees and self.trees[0].linear_feat is not None:
+                raise NotImplementedError(
+                    "pred_contrib with linear_tree is not supported")
             return self._pred_contrib(bins, start_iteration, num_iteration)
-        shrink = 1.0 if self.params.boosting == "rf" else self.params.learning_rate
+        shrink = (1.0 if self.params.boosting == "rf"
+                  else getattr(self, "_base_lr", self.params.learning_rate))
+        if self.trees and self.trees[0].linear_feat is not None:
+            xr = np.ascontiguousarray(X, dtype=np.float32)
+            add_lin = _linear_tree_pred_fn(self._depth_cap)
+            raw = jnp.full(bins.shape[0], float(self.init_score_),
+                           jnp.float32)
+            xr_dev = jnp.asarray(xr)
+            for t in range(start_iteration,
+                           start_iteration + num_iteration):
+                raw = add_lin(raw, self.trees[t], bins, xr_dev,
+                              jnp.float32(shrink))
+            if raw_score:
+                return np.asarray(raw)
+            return np.asarray(self.obj.transform(raw))
         k = self._num_class
         if k > 1:
             cols = []
@@ -1304,8 +1468,10 @@ class Booster:
                                   else getattr(t, f)[c]) for f in fields}
 
         is_rf = p.boosting == "rf"
-        shrink = np.full(len(sel), 1.0 if is_rf else p.learning_rate,
-                         np.float32)
+        shrink = np.full(
+            len(sel),
+            1.0 if is_rf else getattr(self, "_base_lr", p.learning_rate),
+            np.float32)
         outs = []
         for c in range(k):
             tree_dicts = [to_np(t, c if k > 1 else None) for t in sel]
@@ -1399,13 +1565,45 @@ class Booster:
             return out.astype(np.int64)
         return out
 
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """Update trace-dynamic hyper-parameters mid-training (LightGBM
+        ``Booster.reset_parameter``, driven by the ``reset_parameter``
+        callback).  Continuous knobs (learning_rate, lambdas, fractions,
+        min_data_in_leaf, ...) are traced scalars, so NO recompilation
+        happens; shape-static parameters cannot change on a live booster.
+        """
+        newp = parse_params(params, base=self.params)
+        for f in ("num_leaves", "max_bin", "objective", "boosting",
+                  "num_class", "tree_learner", "grow_policy",
+                  "max_cat_threshold", "extra_trees"):
+            if getattr(newp, f) != getattr(self.params, f):
+                raise ValueError(
+                    f"cannot reset shape-static parameter '{f}' on a "
+                    "trained booster (it changes the compiled program)")
+        self.params = newp
+        self._hyper = HyperScalars.from_params(newp)
+        return self
+
     def rollback_one_iter(self) -> "Booster":
         if self.trees:
             tree = self.trees.pop()
             self._forest_cache = None
             self._iter -= 1
             is_rf = self.params.boosting == "rf"
-            shrink = jnp.float32(1.0 if is_rf else self.params.learning_rate)
+            shrink = jnp.float32(
+                1.0 if is_rf
+                else getattr(self, "_base_lr", self.params.learning_rate))
+            if tree.linear_feat is not None:
+                add_lin = _linear_tree_pred_fn(self._depth_cap)
+                if not is_rf:
+                    self._pred_train = add_lin(
+                        self._pred_train, tree, self.train_set.X_binned,
+                        self._xraw, -shrink)
+                for idx, (name, vds, vpred) in enumerate(self._valid):
+                    self._valid[idx] = (
+                        name, vds, add_lin(vpred, tree, vds.X_binned,
+                                           vds._xraw_dev, -shrink))
+                return self
             add = _tree_pred_fn(self._depth_cap, self._num_class)
             if not is_rf:  # rf keeps _pred_train at init score
                 self._pred_train = add(
@@ -1437,6 +1635,10 @@ class Booster:
             raise NotImplementedError(
                 "refit supports additive boosting (gbdt/goss); rf averages "
                 "trees and dart bakes dropout scales into leaf values")
+        if self.trees and self.trees[0].linear_feat is not None:
+            raise NotImplementedError(
+                "refit with linear_tree is not supported (leaf models need "
+                "re-solving, not Newton-constant renewal)")
         if getattr(self.obj, "needs_group", False):
             raise NotImplementedError(
                 "refit with group objectives (lambdarank) needs regrouped "
@@ -1454,7 +1656,7 @@ class Booster:
         p = self.params
         lam = jnp.float32(p.lambda_l2)
         decay = jnp.float32(decay_rate)
-        lr = jnp.float32(p.learning_rate)
+        lr = jnp.float32(getattr(self, "_base_lr", p.learning_rate))
         obj = self.obj
         depth_cap = self._depth_cap
 

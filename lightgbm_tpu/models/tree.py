@@ -62,6 +62,11 @@ class Tree(NamedTuple):
     # categorical subset splits — None for datasets without categoricals
     is_cat_split: Optional[jnp.ndarray] = None  # bool[M]
     cat_mask: Optional[jnp.ndarray] = None      # bool[M, B] bins going LEFT
+    # linear leaves (upstream linear_tree) — None for constant-leaf models.
+    # Prediction at a linear leaf: leaf_value[l] + sum_k coef[l,k] *
+    # raw[linear_feat[l,k]] (feat -1 = unused slot; NaN raw imputes 0).
+    linear_feat: Optional[jnp.ndarray] = None   # i32[M, K] training columns
+    linear_coef: Optional[jnp.ndarray] = None   # f32[M, K]
 
     @property
     def capacity(self) -> int:
@@ -247,7 +252,11 @@ def pad_tree(tree: Tree, capacity: int) -> Tree:
         is_cat_split=(None if tree.is_cat_split is None
                       else p(tree.is_cat_split, False)),
         cat_mask=(None if tree.cat_mask is None
-                  else p_node2(tree.cat_mask)))
+                  else p_node2(tree.cat_mask)),
+        linear_feat=(None if tree.linear_feat is None
+                     else p_node2(tree.linear_feat, -1)),
+        linear_coef=(None if tree.linear_coef is None
+                     else p_node2(tree.linear_coef, 0.0)))
 
 
 def grow_tree(
@@ -923,3 +932,124 @@ def empty_forest(num_trees: int, num_leaves: int) -> Tree:
         split_gain=full(0.0, jnp.float32),
         num_leaves=jnp.ones((num_trees,), jnp.int32),
     )
+
+
+def fit_linear_leaves(tree: Tree, row_leaf: jnp.ndarray, xraw: jnp.ndarray,
+                      g: jnp.ndarray, h: jnp.ndarray, bag: jnp.ndarray,
+                      linear_lambda, k_feats: int,
+                      row_chunk: int = 131072) -> Tuple[Tree, jnp.ndarray]:
+    """Fit ridge-regularized linear models in every leaf (upstream
+    ``linear_tree``, src/treelearner/linear_tree_learner.cpp re-derived
+    tensor-first).
+
+    Upstream solves one small normal-equations system per leaf over the
+    leaf's path features, serially with Eigen.  Here all leaves solve at
+    once: per-leaf path feature lists come from one structure sweep, the
+    per-leaf Gram matrices ``A_l = Z^T H Z`` and moments ``b_l = Z^T g``
+    accumulate via a one-hot matmul over row chunks (the histogram trick,
+    MXU-friendly), and a single batched ``jnp.linalg.solve`` finishes.
+    The Newton objective ``sum_i [g_i f(x_i) + 0.5 h_i f(x_i)^2]`` with
+    ridge ``linear_lambda`` gives ``(Z^T H Z + lam I) beta = -Z^T g``.
+
+    Leaves where the solve is singular/non-finite or with fewer than
+    ``k_feats + 2`` rows keep their constant Newton value (upstream's
+    fallback).  The first ``k_feats`` distinct path features participate
+    (upstream uses all; deep paths truncate — documented divergence).
+    NaN raw values impute 0 for both fit and predict.
+
+    Returns (tree with linear_feat/linear_coef/leaf_value set,
+    per-row prediction delta f(x_i) of THIS tree).
+    """
+    n, num_features = xraw.shape
+    capacity = tree.capacity
+    kp1 = k_feats + 1
+    lam = jnp.asarray(linear_lambda, jnp.float32)
+
+    # 1. per-leaf path feature lists: one forward sweep (children are
+    # created after parents, so parents resolve first).
+    flist0 = jnp.full((capacity, k_feats), -1, jnp.int32)
+    fcnt0 = jnp.zeros((capacity,), jnp.int32)
+
+    def sweep(i, carry):
+        flist, fcnt = carry
+        internal = (~tree.is_leaf[i]) & (tree.left[i] >= 0)
+        f = tree.split_feature[i]
+        present = jnp.any(flist[i] == f)
+        can_add = (~present) & (fcnt[i] < k_feats)
+        child_list = jnp.where(
+            can_add,
+            flist[i].at[jnp.clip(fcnt[i], 0, k_feats - 1)].set(f),
+            flist[i])
+        child_cnt = fcnt[i] + can_add.astype(jnp.int32)
+
+        def put(dst_l, dst_c, child):
+            ok = internal & (child >= 0)
+            safe = jnp.where(ok, child, capacity)
+            return (dst_l.at[safe].set(child_list, mode="drop"),
+                    dst_c.at[safe].set(child_cnt, mode="drop"))
+
+        flist, fcnt = put(flist, fcnt, tree.left[i])
+        flist, fcnt = put(flist, fcnt, tree.right[i])
+        return flist, fcnt
+
+    flist, _ = lax.fori_loop(0, capacity, sweep, (flist0, fcnt0))
+
+    # 2. per-row design Z = [x_pathfeats, 1] with NaN->0 and pad-slot->0.
+    feats = flist[row_leaf]                              # [n, K]
+    xg = jnp.take_along_axis(xraw, jnp.maximum(feats, 0), axis=1)
+    xg = jnp.where((feats >= 0) & jnp.isfinite(xg), xg, 0.0)
+    z = jnp.concatenate([xg, jnp.ones((n, 1), jnp.float32)], axis=1)
+
+    # 3. accumulate A = Z^T H Z and b = Z^T g per leaf, chunked one-hot
+    # matmuls (histogram formulation).  Rows are padded up to a chunk
+    # multiple with zero g/h so every chunk slice is in-bounds and padded
+    # rows contribute exactly nothing (code-review r2: a clamped
+    # dynamic_slice double-counts the tail).
+    gb = g * bag
+    hb = h * bag
+    n_chunks = max(-(-n // row_chunk), 1)
+    n_fit = n_chunks * row_chunk if n > row_chunk else n
+    if n_fit != n:
+        pad = n_fit - n
+        z = jnp.pad(z, ((0, pad), (0, 0)))
+        row_leaf_f = jnp.pad(row_leaf, (0, pad))
+        gb = jnp.pad(gb, (0, pad))
+        hb = jnp.pad(hb, (0, pad))
+    else:
+        row_leaf_f = row_leaf
+
+    def chunk(ci, acc):
+        A, bvec = acc
+        s = ci * (row_chunk if n > row_chunk else n)
+        c = row_chunk if n > row_chunk else n
+        zc = lax.dynamic_slice_in_dim(z, s, c, 0)
+        rlc = lax.dynamic_slice_in_dim(row_leaf_f, s, c, 0)
+        gc = lax.dynamic_slice_in_dim(gb, s, c, 0)
+        hc = lax.dynamic_slice_in_dim(hb, s, c, 0)
+        onehot = (rlc[:, None]
+                  == lax.iota(jnp.int32, capacity)[None]).astype(jnp.float32)
+        zz = zc[:, :, None] * zc[:, None, :]             # [c, K+1, K+1]
+        A = A + jnp.einsum("cm,cij,c->mij", onehot, zz, hc)
+        bvec = bvec + jnp.einsum("cm,ci,c->mi", onehot, zc, gc)
+        return A, bvec
+
+    A0 = jnp.zeros((capacity, kp1, kp1), jnp.float32)
+    b0 = jnp.zeros((capacity, kp1), jnp.float32)
+    if n <= row_chunk:
+        A, bvec = chunk(0, (A0, b0))
+    else:
+        A, bvec = lax.fori_loop(0, n_chunks, chunk, (A0, b0))
+
+    eye = jnp.eye(kp1, dtype=jnp.float32)
+    beta = jnp.linalg.solve(A + (lam + 1e-6) * eye[None],
+                            -bvec[..., None])[..., 0]    # [M, K+1]
+
+    ok = (tree.is_leaf
+          & jnp.all(jnp.isfinite(beta), axis=-1)
+          & (tree.count >= kp1 + 1))
+    coef = jnp.where(ok[:, None], beta[:, :k_feats], 0.0)
+    intercept = jnp.where(ok, beta[:, k_feats], tree.leaf_value)
+    new_tree = tree._replace(leaf_value=intercept, linear_feat=flist,
+                             linear_coef=coef)
+    delta = intercept[row_leaf] + jnp.sum(coef[row_leaf] * xg, axis=1)
+    return new_tree, delta

@@ -79,7 +79,8 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                        row_chunk: int = 131072, is_rf: bool = False,
                        wave_width: int = 1, hist_dtype: str = "f32",
                        goss_k_shard=None, mono_key=None,
-                       extra_trees: bool = False, nbins_key=None):
+                       extra_trees: bool = False, nbins_key=None,
+                       num_class: int = 1):
     """Build the jitted data-parallel round step for a mesh.
 
     Returns step(bins, y, w, bag, pred, feature_mask, hyper) ->
@@ -99,6 +100,42 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                 else jnp.asarray(mono_key, jnp.int32))
     colb = (None if nbins_key is None
             else jnp.asarray(nbins_key, jnp.int32))
+
+    def step_mc(bins, y, w, bag, pred, feature_mask, hyper: HyperScalars,
+                key):
+        """Multiclass: one tree per class per round, the class axis vmapped
+        over the grower INSIDE the shard_map — the per-class histogram
+        psums batch into one collective.  GOSS (when requested) becomes
+        per-shard row re-weighting keyed by the summed |grad| across
+        classes (upstream's per-machine sampling)."""
+        g, h = obj.grad_hess(pred, y, w)                  # [n_shard, K]
+        if goss_k_shard is not None:
+            from ..ops.sampling import goss_weights
+            from jax import lax
+
+            skey = jax.random.fold_in(
+                jax.random.fold_in(key, 0x7FFFFFFF),
+                lax.axis_index(DATA_AXIS))
+            bag = goss_weights(skey, jnp.sum(jnp.abs(g), axis=-1), bag,
+                               hyper.top_rate, hyper.other_rate,
+                               jnp.sum(bag))
+
+        def grow_one(gc, hc, kc):
+            stats = jnp.stack([gc * bag, hc * bag,
+                               (bag > 0).astype(jnp.float32)], axis=-1)
+            return grow_tree(
+                bins, stats, feature_mask, hyper.ctx(), num_leaves,
+                num_bins, hyper.max_depth,
+                ff_bynode=hyper.feature_fraction_bynode, key=kc,
+                axis_name=DATA_AXIS, hist_impl=hist_impl,
+                row_chunk=row_chunk, hist_dtype=hist_dtype,
+                wave_width=wave_width, mono=mono_arr,
+                extra_trees=extra_trees, col_bins=colb)
+
+        keys = jax.random.split(key, num_class)
+        trees, row_leafs = jax.vmap(grow_one, in_axes=(1, 1, 0))(g, h, keys)
+        deltas = jax.vmap(lambda t, rl: t.leaf_value[rl])(trees, row_leafs)
+        return trees, pred + hyper.learning_rate * deltas.T
 
     def step(bins, y, w, bag, pred, feature_mask, hyper: HyperScalars, key):
         g, h = obj.grad_hess(pred, y, w)
@@ -132,7 +169,7 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
         return tree, new_pred
 
     sharded = jax.shard_map(
-        step,
+        step_mc if num_class > 1 else step,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                   P(DATA_AXIS), P(), P(), P()),

@@ -41,6 +41,10 @@ def _tree_to_dict(tree) -> dict:
             str(i): np.flatnonzero(cm2[i]).tolist()
             for i in np.flatnonzero(icb)}
         d["cat_shape"] = list(np.asarray(tree.is_cat_split).shape)
+    if tree.linear_feat is not None:
+        d["linear_feat"] = np.asarray(tree.linear_feat).tolist()
+        d["linear_coef"] = np.asarray(tree.linear_coef,
+                                      np.float64).tolist()
     return d
 
 
@@ -72,6 +76,10 @@ def _tree_from_dict(d: dict):
         num_leaves=jnp.asarray(d["num_leaves"], jnp.int32),
         is_cat_split=is_cat_split,
         cat_mask=cat_mask,
+        linear_feat=(jnp.asarray(d["linear_feat"], jnp.int32)
+                     if "linear_feat" in d else None),
+        linear_coef=(jnp.asarray(d["linear_coef"], jnp.float32)
+                     if "linear_coef" in d else None),
     )
 
 
@@ -115,6 +123,11 @@ def booster_to_string(booster, num_iteration: Optional[int] = None,
 
     params_dict = dataclasses.asdict(booster.params)
     params_dict.pop("extra", None)
+    # stored leaf values are normalized to the booster's BASE learning rate
+    # (reset_parameter schedules bake lr_i/base in at append time), so the
+    # reloaded predict-time shrink must be the base, not the final lr
+    params_dict["learning_rate"] = float(
+        getattr(booster, "_base_lr", booster.params.learning_rate))
     doc = {
         "format_version": _FORMAT_VERSION,
         "framework": "lightgbm_tpu",
@@ -233,7 +246,9 @@ def dump_booster_dict(booster, num_iteration: Optional[int] = None,
             trees_info.append({
                 "tree_index": idx,
                 "num_leaves": int(np.asarray(t.num_leaves).max()),
-                "shrinkage": float(booster.params.learning_rate),
+                "shrinkage": float(
+                    getattr(booster, "_base_lr",
+                            booster.params.learning_rate)),
                 "tree_structure": node_dict(t, idx, 0),
             })
             idx += 1

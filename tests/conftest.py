@@ -46,3 +46,66 @@ def small_binary(rng):
     logits = 1.5 * X[:, 0] - X[:, 1] + X[:, 2] * X[:, 3]
     y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(np.float64)
     return X, y
+
+
+# ---------------------------------------------------------------------------
+# XLA-CPU compile-state hygiene: with ~160 tests compiling hundreds of large
+# programs (8-device shard_maps, scan-of-scan SHAP/fused programs) in ONE
+# process, the CPU backend's compiler eventually segfaults inside
+# backend_compile (observed roaming across unrelated tests past ~50% of the
+# suite; stack-limit independent).  Dropping every cached executable and the
+# framework's jit-wrapper caches every N tests keeps the per-process compile
+# state bounded.  Cost: a few recompiles per block; correctness unaffected.
+# ---------------------------------------------------------------------------
+_TESTS_PER_CACHE_EPOCH = 24
+_test_counter = [0]
+
+
+def _clear_all_jit_caches():
+    import jax
+
+    from lightgbm_tpu.models import gbdt as _g
+
+    for fn_name in ("_round_fn", "_multi_round_fn", "_tree_pred_fn",
+                    "_linear_tree_pred_fn", "_eval_fn", "_bag_fn",
+                    "_feature_mask_fn"):
+        fn = getattr(_g, fn_name, None)
+        if fn is not None and hasattr(fn, "cache_clear"):
+            fn.cache_clear()
+    try:
+        from lightgbm_tpu.models import fused as _f
+        _f._fused_cv_fn.cache_clear()
+    except Exception:
+        pass
+    try:
+        from lightgbm_tpu.parallel import data_parallel as _dp
+        _dp.make_dp_train_step.cache_clear()
+    except Exception:
+        pass
+    try:
+        from lightgbm_tpu.parallel import feature_parallel as _fp
+        _fp.make_fp_train_step.cache_clear()
+    except Exception:
+        pass
+    try:
+        from lightgbm_tpu.ops import shap as _s
+        _s._forest_shap_fn.cache_clear()
+    except Exception:
+        pass
+    try:
+        from lightgbm_tpu.ops import histogram as _h
+        for name in dir(_h):
+            f = getattr(_h, name)
+            if hasattr(f, "cache_clear"):
+                f.cache_clear()
+    except Exception:
+        pass
+    jax.clear_caches()
+
+
+@pytest.fixture(autouse=True)
+def _bounded_compile_state():
+    yield
+    _test_counter[0] += 1
+    if _test_counter[0] % _TESTS_PER_CACHE_EPOCH == 0:
+        _clear_all_jit_caches()

@@ -1,0 +1,143 @@
+"""linear_tree (ridge models in leaves) — models/tree.py fit_linear_leaves.
+
+Upstream contract (LightGBM linear_tree): leaves predict
+``const + coef . x_pathfeats`` fit by ridge-regularized Newton; constant
+leaves remain the fallback for degenerate solves.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def pw_linear():
+    rng = np.random.default_rng(0)
+    n = 2500
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (np.where(X[:, 0] > 0, 3.0 * X[:, 0], -1.0 * X[:, 0])
+         + 0.5 * X[:, 1] + 0.05 * rng.normal(size=n)).astype(np.float32)
+    return X, y
+
+
+def test_linear_beats_constant_on_piecewise_linear(pw_linear):
+    X, y = pw_linear
+    ds = lgb.Dataset(X, label=y)
+    base = {"objective": "regression", "verbosity": -1, "num_leaves": 4,
+            "learning_rate": 0.5}
+    b_lin = lgb.train({**base, "linear_tree": True}, ds, num_boost_round=8)
+    b_con = lgb.train(base, ds, num_boost_round=8)
+    r_lin = float(np.sqrt(np.mean((b_lin.predict(X) - y) ** 2)))
+    r_con = float(np.sqrt(np.mean((b_con.predict(X) - y) ** 2)))
+    assert r_lin < 0.5 * r_con, (r_lin, r_con)
+
+
+def test_predict_matches_train_preds(pw_linear):
+    X, y = pw_linear
+    ds = lgb.Dataset(X, label=y)
+    b = lgb.train({"objective": "regression", "verbosity": -1,
+                   "num_leaves": 7, "linear_tree": True}, ds,
+                  num_boost_round=5)
+    tp = np.asarray(b._pred_train)[: len(y)]
+    np.testing.assert_allclose(tp, b.predict(X, raw_score=True),
+                               rtol=1e-5, atol=1e-5)
+    # truncation works through the linear path
+    p2 = b.predict(X[:50], num_iteration=2)
+    p5 = b.predict(X[:50])
+    assert not np.allclose(p2, p5)
+
+
+def test_linear_tree_save_load_roundtrip(pw_linear, tmp_path):
+    X, y = pw_linear
+    ds = lgb.Dataset(X, label=y)
+    b = lgb.train({"objective": "regression", "verbosity": -1,
+                   "num_leaves": 5, "linear_tree": True}, ds,
+                  num_boost_round=4)
+    path = str(tmp_path / "lin.json")
+    b.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    assert loaded.trees[0].linear_feat is not None
+    np.testing.assert_allclose(b.predict(X[:100]), loaded.predict(X[:100]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_linear_tree_early_stopping_valid(pw_linear):
+    X, y = pw_linear
+    dtrain = lgb.Dataset(X[:2000], label=y[:2000])
+    dvalid = dtrain.create_valid(X[2000:], label=y[2000:])
+    b = lgb.train({"objective": "regression", "verbosity": -1,
+                   "num_leaves": 5, "linear_tree": True},
+                  dtrain, num_boost_round=100, valid_sets=[dvalid],
+                  early_stopping_rounds=5)
+    assert 0 < b.best_iteration <= 100
+    # valid-set eval used the LINEAR leaf values: the recorded best score
+    # matches an explicit predict at best_iteration
+    pred = b.predict(X[2000:], num_iteration=b.best_iteration)
+    mse = float(np.mean((y[2000:] - pred) ** 2))
+    np.testing.assert_allclose(mse, b.best_score["valid_0"]["l2"],
+                               rtol=1e-4)
+
+
+def test_linear_tree_nan_and_guardrails(pw_linear):
+    X, y = pw_linear
+    Xn = X.copy()
+    Xn[::7, 0] = np.nan
+    ds = lgb.Dataset(Xn, label=y)
+    b = lgb.train({"objective": "regression", "verbosity": -1,
+                   "linear_tree": True}, ds, num_boost_round=3)
+    p = b.predict(Xn[:100])
+    assert np.all(np.isfinite(p))
+    with pytest.raises(NotImplementedError, match="gbdt"):
+        lgb.train({"objective": "regression", "boosting": "dart",
+                   "linear_tree": True}, ds, 2)
+    with pytest.raises(NotImplementedError):
+        b.predict(Xn[:10], pred_contrib=True)
+    with pytest.raises(NotImplementedError):
+        b.refit(X, y)
+
+
+def test_chunked_fit_matches_single_pass(pw_linear):
+    """The chunked normal-equations accumulation (row_chunk) must agree
+    with a single-pass fit (code-review r2: a clamped tail chunk silently
+    double-counted rows)."""
+    X, y = pw_linear
+    ds = lgb.Dataset(X, label=y)
+    base = {"objective": "regression", "verbosity": -1, "num_leaves": 4,
+            "linear_tree": True}
+    b_one = lgb.train(base, ds, num_boost_round=3)
+    # row_chunk smaller than n forces the multi-chunk path on same data
+    b_chunk = lgb.train({**base, "row_chunk": 1024}, ds, num_boost_round=3)
+    np.testing.assert_allclose(b_one.predict(X[:200]),
+                               b_chunk.predict(X[:200]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_rollback_with_linear_tree(pw_linear):
+    X, y = pw_linear
+    ds = lgb.Dataset(X, label=y)
+    b = lgb.train({"objective": "regression", "verbosity": -1,
+                   "num_leaves": 4, "linear_tree": True}, ds,
+                  num_boost_round=4)
+    b.rollback_one_iter()
+    # train preds must equal an explicit 3-tree predict (the rolled-back
+    # tree's coef.x part must be gone too)
+    tp = np.asarray(b._pred_train)[: len(y)]
+    np.testing.assert_allclose(tp, b.predict(X, raw_score=True,
+                                             num_iteration=3),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_linear_lambda_regularizes(pw_linear):
+    X, y = pw_linear
+    ds = lgb.Dataset(X, label=y)
+    base = {"objective": "regression", "verbosity": -1, "num_leaves": 4,
+            "linear_tree": True}
+    b0 = lgb.train(base, ds, num_boost_round=2)
+    b9 = lgb.train({**base, "linear_lambda": 1e4}, ds, num_boost_round=2)
+
+    def coef_norm(b):
+        return float(sum(np.abs(np.asarray(t.linear_coef)).sum()
+                         for t in b.trees))
+
+    assert coef_norm(b9) < coef_norm(b0)

@@ -222,3 +222,41 @@ def test_dp_goss_tree_is_replicated_and_padding_free():
     # (b) no fabricated counts: the root count equals the live row count
     root_count = float(np.asarray(b.trees[0].count)[0])
     assert root_count <= n + 1e-3, root_count
+
+
+def test_dp_multiclass_matches_serial():
+    """tree_learner='data' with multiclass: the class axis vmaps INSIDE the
+    shard_map (per-class histogram psums batch into one collective) and the
+    result must be bit-identical to serial training."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(13)
+    n, F, K = 1024, 5, 3
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = (np.argmax(X[:, :K] + 0.3 * rng.normal(size=(n, K)), axis=1)
+         .astype(np.float32))
+    params = {"objective": "multiclass", "num_class": K, "num_leaves": 7,
+              "verbosity": -1, "min_data_in_leaf": 5}
+    b_serial = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    b_dp = lgb.train({**params, "tree_learner": "data"},
+                     lgb.Dataset(X, label=y), num_boost_round=5)
+    np.testing.assert_allclose(b_serial.predict(X[:100]),
+                               b_dp.predict(X[:100]), rtol=1e-5, atol=1e-6)
+
+
+def test_dp_multiclass_goss_trains():
+    import numpy as np
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(14)
+    n, F, K = 2048, 4, 3
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = rng.integers(0, K, n).astype(np.float32)
+    b = lgb.train({"objective": "multiclass", "num_class": K,
+                   "boosting": "goss", "tree_learner": "data",
+                   "num_leaves": 7, "verbosity": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=4)
+    p = b.predict(X[:50])
+    assert p.shape == (50, K)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
