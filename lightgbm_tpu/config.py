@@ -171,6 +171,7 @@ _ALIASES: Dict[str, str] = {
     "quantile_alpha": "alpha",
     "fair_c": "fair_c",
     "poisson_max_delta_step": "poisson_max_delta_step",
+    "tweedie_variance_power": "tweedie_variance_power",
     "lambdarank_truncation_level": "lambdarank_truncation_level",
     "lambdarank_norm": "lambdarank_norm",
     "label_gain": "label_gain",
@@ -211,6 +212,12 @@ _OBJECTIVE_ALIASES: Dict[str, str] = {
     "fair": "fair",
     "poisson": "poisson",
     "quantile": "quantile",
+    "mape": "mape",
+    "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma",
+    "tweedie": "tweedie",
+    "cross_entropy": "cross_entropy",
+    "xentropy": "cross_entropy",
     "binary": "binary",
     "binary_logloss": "binary",
     "binary:logistic": "binary",
@@ -248,6 +255,14 @@ _METRIC_ALIASES: Dict[str, str] = {
     "fair": "fair",
     "poisson": "poisson",
     "quantile": "quantile",
+    "mape": "mape",
+    "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma",
+    "gamma_deviance": "gamma_deviance",
+    "gamma-deviance": "gamma_deviance",
+    "tweedie": "tweedie",
+    "cross_entropy": "cross_entropy",
+    "xentropy": "cross_entropy",
     "binary_logloss": "binary_logloss",
     "binary": "binary_logloss",
     "logloss": "binary_logloss",
@@ -367,6 +382,7 @@ class Params:
     alpha: float = 0.9
     fair_c: float = 1.0
     poisson_max_delta_step: float = 0.7
+    tweedie_variance_power: float = 1.5
     lambdarank_truncation_level: int = 30
     lambdarank_norm: bool = True
     label_gain: Optional[List[float]] = None
@@ -523,6 +539,12 @@ def _validate(p: Params) -> None:
                 "conservative")
     if p.path_smooth < 0:
         raise ValueError(f"path_smooth must be >= 0, got {p.path_smooth}")
+    if p.objective == "tweedie" or "tweedie" in p.metric:
+        if not (1.0 < p.tweedie_variance_power < 2.0):
+            raise ValueError(
+                "tweedie_variance_power must be in (1, 2), got "
+                f"{p.tweedie_variance_power} (use objective='poisson' for "
+                "rho=1 and 'gamma' for rho=2)")
     if p.linear_tree:
         if p.linear_lambda < 0:
             raise ValueError(
@@ -574,6 +596,10 @@ def default_metric_for_objective(objective: str) -> str:
         "fair": "fair",
         "poisson": "poisson",
         "quantile": "quantile",
+        "mape": "mape",
+        "gamma": "gamma",
+        "tweedie": "tweedie",
+        "cross_entropy": "cross_entropy",
         "binary": "binary_logloss",
         "multiclass": "multi_logloss",
         "multiclassova": "multi_logloss",

@@ -66,6 +66,35 @@ def _quantile(pred, y, w, alpha=0.9):
     return _wmean(jnp.maximum(alpha * r, (alpha - 1) * r), w)
 
 
+def _mape(pred, y, w):
+    return _wmean(jnp.abs(pred - y) / jnp.maximum(jnp.abs(y), 1.0), w)
+
+
+def _gamma_nll(mu, y, w):
+    # upstream "gamma" metric: negative log-likelihood at shape=1
+    mu = jnp.maximum(mu, 1e-15)
+    ys = jnp.maximum(y, 1e-15)
+    return _wmean(jnp.log(mu) + ys / mu, w)
+
+
+def _gamma_deviance(mu, y, w):
+    mu = jnp.maximum(mu, 1e-15)
+    ys = jnp.maximum(y, 1e-15)
+    return _wmean(2.0 * (jnp.log(mu / ys) + ys / mu - 1.0), w)
+
+
+def _tweedie_nll(mu, y, w, rho=1.5):
+    mu = jnp.maximum(mu, 1e-15)
+    a = y * jnp.exp((1.0 - rho) * jnp.log(mu)) / (1.0 - rho)
+    b = jnp.exp((2.0 - rho) * jnp.log(mu)) / (2.0 - rho)
+    return _wmean(-a + b, w)
+
+
+def _xentropy(p, y, w):
+    p = jnp.clip(p, 1e-15, 1 - 1e-15)
+    return _wmean(-(y * jnp.log(p) + (1 - y) * jnp.log(1 - p)), w)
+
+
 def _auc(score, y, w):
     """Weighted ROC-AUC via the rank statistic, fully on device.
 
@@ -107,6 +136,11 @@ _METRICS: Dict[str, Metric] = {
     "huber": Metric("huber", False, _huber),
     "poisson": Metric("poisson", False, _poisson_nll),
     "quantile": Metric("quantile", False, _quantile),
+    "mape": Metric("mape", False, _mape),
+    "gamma": Metric("gamma", False, _gamma_nll),
+    "gamma_deviance": Metric("gamma_deviance", False, _gamma_deviance),
+    "tweedie": Metric("tweedie", False, _tweedie_nll),
+    "cross_entropy": Metric("cross_entropy", False, _xentropy),
     "binary_logloss": Metric("binary_logloss", False, _binary_logloss),
     "binary_error": Metric("binary_error", False, _binary_error),
     "auc": Metric("auc", True, _auc),
@@ -127,4 +161,8 @@ def get_metric(name: str, params=None) -> Metric:
         alpha = float(params.alpha)
         return Metric(m.name, m.higher_better,
                       lambda p, y, w, a=alpha: m.fn(p, y, w, a))
+    if params is not None and name == "tweedie":
+        rho = float(params.tweedie_variance_power)
+        return Metric(m.name, m.higher_better,
+                      lambda p, y, w, r=rho: m.fn(p, y, w, r))
     return m

@@ -146,6 +146,7 @@ def _objective_static_key(obj: Objective, p: Params) -> tuple:
         p.lambdarank_norm,
         p.num_class,
         p.extra.get("fobj"),
+        p.tweedie_variance_power,
     )
 
 
@@ -170,12 +171,13 @@ def _rebuild_objective(key: tuple) -> Objective:
     if key and key[0] == "__group_objective__":
         return key[1]
     (name, sigmoid, pos_weight, alpha, fair_c, pmd, trunc, norm, num_class,
-     fobj) = (key + (None,))[:10]
+     fobj, tvp) = (key + (None, 1.5))[:11]
     p = Params(
         objective="none" if fobj is not None else name,
         sigmoid=sigmoid, alpha=alpha, fair_c=fair_c,
         poisson_max_delta_step=pmd, lambdarank_truncation_level=trunc,
         lambdarank_norm=norm, num_class=max(num_class, 1),
+        tweedie_variance_power=tvp,
     )
     if fobj is not None:
         p.extra["fobj"] = fobj
@@ -189,7 +191,8 @@ def _goss_compact_round(bins, y, w, bag, pred, fmask, hyper: HyperScalars,
                         key, g, h, goss_k, num_leaves, num_bins, hist_impl,
                         row_chunk, hist_dtype, wave_width, cat_info,
                         renew_alpha, axis_name=None, sample_key=None,
-                        mono=None, extra_trees=False, col_bins=None):
+                        mono=None, extra_trees=False, col_bins=None,
+                        renew_scale=None):
     """One compacted GOSS round (shared by the per-round and scanned paths
     — the two MUST stay in RNG lockstep for fused == host training).
 
@@ -226,8 +229,11 @@ def _goss_compact_round(bins, y, w, bag, pred, fmask, hyper: HyperScalars,
         wave_width=wave_width, cat_info=cat_info, axis_name=axis_name,
         mono=mono, extra_trees=extra_trees, col_bins=col_bins)
     if renew_alpha is not None:
+        rw = w[idx] * wt
+        if renew_scale is not None:
+            rw = rw * renew_scale(y[idx])
         tree = renew_leaf_values(tree, rl_c, y[idx] - pred[idx],
-                                 w[idx] * wt, renew_alpha)
+                                 rw, renew_alpha)
     new_pred = pred + hyper.learning_rate * predict_tree_binned(
         tree, bins, num_leaves)
     return tree, new_pred
@@ -249,6 +255,7 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
     obj = _rebuild_objective(obj_key)
     is_goss = goss_k is not None
     renew_alpha = getattr(obj, "renew_alpha", None)
+    renew_scale = getattr(obj, "renew_scale", None)
     mono_arr = (None if mono_key is None
                 else jnp.asarray(mono_key, jnp.int32))
     colb = (None if nbins_key is None
@@ -305,7 +312,8 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                 goss_k, num_leaves, num_bins, hist_impl, row_chunk,
                 hist_dtype, wave_width,
                 _build_cat_info(cat_key, bins.shape[1]), renew_alpha,
-                mono=mono_arr, extra_trees=extra_trees, col_bins=colb)
+                mono=mono_arr, extra_trees=extra_trees, col_bins=colb,
+                renew_scale=renew_scale)
 
         return round_fn_goss
 
@@ -352,7 +360,8 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
             cat_info=_build_cat_info(cat_key, bins.shape[1]),
             mono=mono_arr, extra_trees=extra_trees, col_bins=colb)
         if renew_alpha is not None:
-            tree = renew_leaf_values(tree, row_leaf, y - pred, w * bag,
+            rw = w * bag if renew_scale is None else w * bag * renew_scale(y)
+            tree = renew_leaf_values(tree, row_leaf, y - pred, rw,
                                      renew_alpha)
         shrink = jnp.where(is_rf, 1.0, hyper.learning_rate)
         new_pred = pred + shrink * tree.leaf_value[row_leaf]
@@ -384,6 +393,7 @@ def _multi_round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
     """
     obj = _rebuild_objective(obj_key)
     renew_alpha = getattr(obj, "renew_alpha", None)
+    renew_scale = getattr(obj, "renew_scale", None)
     mono_arr = (None if mono_key is None
                 else jnp.asarray(mono_key, jnp.int32))
     colb = (None if nbins_key is None
@@ -420,7 +430,8 @@ def _multi_round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                     bins, y, w, bag, pred, fmask, hyper, rkey, g, h,
                     goss_k, num_leaves, num_bins, hist_impl, row_chunk,
                     hist_dtype, wave_width, cat_info, renew_alpha,
-                    mono=mono_arr, extra_trees=extra_trees, col_bins=colb)
+                    mono=mono_arr, extra_trees=extra_trees, col_bins=colb,
+                    renew_scale=renew_scale)
                 return (new_pred, bag), tree
             stats = jnp.stack(
                 [g * bag, h * bag, (bag > 0).astype(jnp.float32)], axis=-1)
@@ -433,7 +444,9 @@ def _multi_round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                 cat_info=cat_info, mono=mono_arr, extra_trees=extra_trees,
                 col_bins=colb)
             if renew_alpha is not None:
-                tree = renew_leaf_values(tree, row_leaf, y - pred, w * bag,
+                rw = (w * bag if renew_scale is None
+                      else w * bag * renew_scale(y))
+                tree = renew_leaf_values(tree, row_leaf, y - pred, rw,
                                          renew_alpha)
             if is_rf:
                 new_pred = pred
@@ -503,7 +516,10 @@ def _linear_tree_pred_fn(depth_cap: int):
 @functools.lru_cache(maxsize=None)
 def _eval_fn(obj_key: tuple, metric_names: tuple, metric_cfg: tuple):
     obj = _rebuild_objective(obj_key)
-    p = Params(alpha=metric_cfg[0]) if metric_cfg else Params()
+    p = (Params(alpha=metric_cfg[0],
+                tweedie_variance_power=(metric_cfg[1] if len(metric_cfg) > 1
+                                        else 1.5))
+         if metric_cfg else Params())
     metrics = [get_metric(m, p) for m in metric_names]
 
     @jax.jit
@@ -868,11 +884,22 @@ class Booster:
                 "this Dataset; rebuild the Dataset with "
                 "reference=<original training Dataset> (or identical data) "
                 "before continuing training")
+        prev_linear = bool(prev.trees
+                           and prev.trees[0].linear_feat is not None)
+        if prev_linear != bool(p.linear_tree):
+            raise ValueError(
+                "init_model and the continuation must agree on linear_tree "
+                f"(init_model linear={prev_linear}, params "
+                f"linear_tree={p.linear_tree}) — a forest cannot mix "
+                "constant and linear leaves")
         prev_lr = float(getattr(prev, "_base_lr",
                                 prev.params.learning_rate))
         scale = jnp.float32(prev_lr / self._base_lr)
-        self.trees = [t._replace(leaf_value=t.leaf_value * scale)
-                      for t in prev.trees]
+        self.trees = [t._replace(
+            leaf_value=t.leaf_value * scale,
+            linear_coef=(None if t.linear_coef is None
+                         else t.linear_coef * scale))
+            for t in prev.trees]
         self._iter = len(self.trees)
         self._forest_cache = None
         # restart from the PREVIOUS model's base score and replay its trees
@@ -894,11 +921,17 @@ class Booster:
                     np.zeros(int(ds.row_mask.shape[0]) - ds.num_data_,
                              np.float32)])
                 self._pred_train = self._pred_train + jnp.asarray(base)
-        add = _tree_pred_fn(self._depth_cap, self._num_class)
         shrink = jnp.float32(self._base_lr)
-        for tree in self.trees:
-            self._pred_train = add(self._pred_train, tree, ds.X_binned,
-                                   shrink)
+        if p.linear_tree:
+            add_lin = _linear_tree_pred_fn(self._depth_cap)
+            for tree in self.trees:
+                self._pred_train = add_lin(
+                    self._pred_train, tree, ds.X_binned, self._xraw, shrink)
+        else:
+            add = _tree_pred_fn(self._depth_cap, self._num_class)
+            for tree in self.trees:
+                self._pred_train = add(self._pred_train, tree, ds.X_binned,
+                                       shrink)
 
     def _sample_bag_and_fmask(self, i: int):
         """Per-round stochasticity shared by plain and DART rounds: resample
@@ -971,11 +1004,13 @@ class Booster:
             goss_k_shard = None
             if goss_k is not None:
                 # per-shard compaction (upstream's data-parallel GOSS
-                # samples per machine)
+                # samples per machine); multiclass GOSS re-weights without
+                # compacting, so its static sizing keeps the full rows
                 n_dev = self._dp_mesh.devices.size
                 goss_k_shard = (max(goss_k[0] // n_dev, 1),
                                 max(goss_k[1] // n_dev, 1))
-                eff_rows = sum(goss_k_shard)
+                if self._num_class == 1:
+                    eff_rows = sum(goss_k_shard)
             fn = make_dp_train_step(
                 self._dp_mesh, self._obj_key, p.num_leaves, self._num_bins,
                 p.extra.get("hist_impl", "auto"),
@@ -1225,7 +1260,9 @@ class Booster:
         # (pred, y, w) metric signature via the grouped eval path
         plain = tuple(m for m in metric_names if m not in ("ndcg", "map"))
         if plain:
-            fn = _eval_fn(self._obj_key, plain, (self.params.alpha,))
+            fn = _eval_fn(self._obj_key, plain,
+                          (self.params.alpha,
+                           self.params.tweedie_variance_power))
             vals = fn(pred_raw, ds.y, ds.w)
             for mname, v in zip(plain, vals):
                 m = get_metric(mname, self.params)
@@ -1383,8 +1420,8 @@ class Booster:
         X = _to_2d_float_array(data)
         codes = self._bin_mapper_for_predict().transform(X)
         bins = jnp.asarray(codes)
-        forest = self._stacked_forest()
         if pred_leaf:
+            forest = self._stacked_forest()
             # LightGBM contract: [n, num_iteration * num_class], iteration-
             # major, values are per-tree leaf ordinals in [0, num_leaves)
             # — not node-array slots (ADVICE r1): rank leaf slots by node id
@@ -1418,6 +1455,7 @@ class Booster:
             if raw_score:
                 return np.asarray(raw)
             return np.asarray(self.obj.transform(raw))
+        forest = self._stacked_forest()
         k = self._num_class
         if k > 1:
             cols = []
@@ -1573,9 +1611,13 @@ class Booster:
         happens; shape-static parameters cannot change on a live booster.
         """
         newp = parse_params(params, base=self.params)
-        for f in ("num_leaves", "max_bin", "objective", "boosting",
+        static = ["num_leaves", "max_bin", "objective", "boosting",
                   "num_class", "tree_learner", "grow_policy",
-                  "max_cat_threshold", "extra_trees"):
+                  "max_cat_threshold", "extra_trees", "linear_tree"]
+        if self.params.boosting == "goss":
+            # GOSS sampling counts are compile-time constants (goss_k)
+            static += ["top_rate", "other_rate"]
+        for f in static:
             if getattr(newp, f) != getattr(self.params, f):
                 raise ValueError(
                     f"cannot reset shape-static parameter '{f}' on a "

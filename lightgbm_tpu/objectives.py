@@ -150,6 +150,102 @@ class Quantile(Objective):
         return g * w, w
 
 
+class MAPE(Objective):
+    """Mean absolute percentage error (upstream ``RegressionMAPELOSS``):
+    L1 on residuals scaled by ``1/max(1, |y|)`` — gradients are signs
+    carrying that scale as an extra weight, and leaf values renew to the
+    weighted median like L1."""
+
+    name = "mape"
+
+    @property
+    def renew_alpha(self):
+        return 0.5
+
+    @staticmethod
+    def renew_scale(y):
+        """Leaf renewal weights carry the MAPE 1/max(1,|y|) scale
+        (upstream RegressionMAPELOSS label_weight_) — a plain weighted
+        median would let large-|y| rows dominate leaf values."""
+        return 1.0 / jnp.maximum(jnp.abs(y), 1.0)
+
+    def init_score(self, y, w):
+        if not self.params.boost_from_average:
+            return 0.0
+        return _weighted_quantile(y, w / np.maximum(np.abs(y), 1.0), 0.5)
+
+    def grad_hess(self, pred, y, w):
+        scale = 1.0 / jnp.maximum(jnp.abs(y), 1.0)
+        return jnp.sign(pred - y) * scale * w, scale * w
+
+
+class Gamma(Objective):
+    """Gamma deviance with log link (upstream ``RegressionGammaLoss``):
+    raw score is log(mu); grad = 1 - y*exp(-s), hess = y*exp(-s)."""
+
+    name = "gamma"
+
+    def init_score(self, y, w):
+        mean = max(np.average(y, weights=np.maximum(w, 0)), 1e-9)
+        return float(np.log(mean))
+
+    def grad_hess(self, pred, y, w):
+        e = jnp.exp(-pred)
+        return (1.0 - y * e) * w, jnp.maximum(y * e, 1e-16) * w
+
+    def transform(self, raw):
+        return jnp.exp(raw)
+
+
+class Tweedie(Objective):
+    """Tweedie deviance, variance power rho in (1, 2) (upstream
+    ``RegressionTweedieLoss``): raw score is log(mu);
+    grad = -y*exp((1-rho)s) + exp((2-rho)s)."""
+
+    name = "tweedie"
+
+    def __init__(self, params: Params):
+        super().__init__(params)
+        self.rho = float(params.tweedie_variance_power)
+
+    def init_score(self, y, w):
+        mean = max(np.average(y, weights=np.maximum(w, 0)), 1e-9)
+        return float(np.log(mean))
+
+    def grad_hess(self, pred, y, w):
+        rho = jnp.float32(self.rho)
+        a = jnp.exp((1.0 - rho) * pred)
+        b = jnp.exp((2.0 - rho) * pred)
+        g = -y * a + b
+        h = -y * (1.0 - rho) * a + (2.0 - rho) * b
+        return g * w, jnp.maximum(h, 1e-16) * w
+
+    def transform(self, raw):
+        return jnp.exp(raw)
+
+
+class CrossEntropy(Objective):
+    """Cross-entropy on CONTINUOUS labels in [0, 1] (upstream
+    ``CrossEntropy`` / objective="xentropy"): logistic link without the
+    sigmoid-scale knob; unlike ``binary`` the label need not be 0/1."""
+
+    name = "cross_entropy"
+
+    def init_score(self, y, w):
+        if not self.params.boost_from_average:
+            return 0.0
+        pbar = float(np.average(y, weights=np.maximum(w, 1e-12)))
+        pbar = min(max(pbar, 1e-12), 1 - 1e-12)
+        return float(np.log(pbar / (1 - pbar)))
+
+    def grad_hess(self, pred, y, w):
+        p = jax_sigmoid(pred)
+        return (p - y) * w, jnp.maximum(p * (1.0 - p), 1e-16) * w
+
+    def transform(self, raw):
+        return jax_sigmoid(raw)
+
+
 class Binary(Objective):
     """Binary logloss on labels {0,1}; raw score is a logit.
 
@@ -216,6 +312,10 @@ _REGISTRY: Dict[str, type] = {
     "fair": Fair,
     "poisson": Poisson,
     "quantile": Quantile,
+    "mape": MAPE,
+    "gamma": Gamma,
+    "tweedie": Tweedie,
+    "cross_entropy": CrossEntropy,
     "binary": Binary,
 }
 
