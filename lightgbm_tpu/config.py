@@ -118,6 +118,7 @@ _ALIASES: Dict[str, str] = {
     "early_stopping_rounds": "early_stopping_round",
     "early_stopping": "early_stopping_round",
     "n_iter_no_change": "early_stopping_round",
+    "early_stopping_min_delta": "early_stopping_min_delta",
     "first_metric_only": "first_metric_only",
     "max_delta_step": "max_delta_step",
     "lambda_l1": "lambda_l1",
@@ -346,6 +347,7 @@ class Params:
     # splits per pass — the large-data fast path); auto picks by data size.
     grow_policy: str = "auto"
     early_stopping_round: int = 0
+    early_stopping_min_delta: float = 0.0
     first_metric_only: bool = False
     max_delta_step: float = 0.0
     lambda_l1: float = 0.0

@@ -447,6 +447,16 @@ class Dataset:
         self._init_score = None if init_score is None else _to_1d_float_array(init_score)
         return self
 
+    def feature_num_bin(self, feature: int) -> int:
+        """Number of bins a feature actually uses (LightGBM
+        ``Dataset.feature_num_bin``); original-feature indexed."""
+        self.construct()
+        return int(self.bin_mapper.n_bins[int(feature)])
+
+    def get_feature_name(self) -> List[str]:
+        self.construct()
+        return list(self.feature_names)
+
     def get_field(self, name: str):
         return {
             "label": self._label, "weight": self._weight,

@@ -91,7 +91,8 @@ def train(
             getattr(c, "order", None) == 30 for c in cbs):
         cbs.append(early_stopping(p.early_stopping_round,
                                   first_metric_only=p.first_metric_only,
-                                  verbose=p.verbosity > 0))
+                                  verbose=p.verbosity > 0,
+                                  min_delta=p.early_stopping_min_delta))
     if verbose_eval not in (None, False) and not any(
             getattr(c, "order", None) == 10
             and not getattr(c, "before_iteration", False) for c in cbs):
@@ -329,7 +330,8 @@ def cv(
             getattr(c, "order", None) == 30 for c in cbs):
         cbs.append(early_stopping(p.early_stopping_round,
                                   first_metric_only=p.first_metric_only,
-                                  verbose=p.verbosity > 0))
+                                  verbose=p.verbosity > 0,
+                                  min_delta=p.early_stopping_min_delta))
     if verbose_eval not in (None, False) and not any(
             getattr(c, "order", None) == 10
             and not getattr(c, "before_iteration", False) for c in cbs):

@@ -71,12 +71,15 @@ from ..ops.sampling import sample_feature_mask as _sample_features_within
 
 @functools.lru_cache(maxsize=None)
 def _fused_cv_fn(obj_key: tuple, num_leaves: int, num_bins: int,
-                 metric_name: str, metric_alpha: float, t_max: int,
+                 metric_name: str, metric_alpha: float,
+                 metric_rho: float, t_max: int,
                  bagging_freq: int, n_configs: int, n_folds: int,
                  hist_impl: str, row_chunk: int, hist_dtype: str = "f32"):
     """Build the jitted fused-cv program for one static configuration."""
     obj = _rebuild_objective(obj_key)
-    metric = get_metric(metric_name, Params(alpha=metric_alpha))
+    metric = get_metric(metric_name,
+                        Params(alpha=metric_alpha,
+                               tweedie_variance_power=metric_rho))
     sign = 1.0 if metric.higher_better else -1.0
     batch = n_configs * n_folds
 
@@ -184,6 +187,9 @@ def fused_cv_eligible(p: Params, feval, callbacks, train_set=None) -> bool:
         return False
     if p.boosting not in ("gbdt",):
         return False
+    if p.early_stopping_min_delta != 0.0:
+        # the fused while-loop early stop compares without a tolerance
+        return False
     if p.monotone_constraints is not None or p.extra_trees \
             or p.linear_tree:
         # constrained/randomized split selection needs the per-booster
@@ -268,7 +274,8 @@ def run_fused_cv_batch(
 
     run_segment, init_carry, finalize = _fused_cv_fn(
         _objective_static_key(obj, p0), p0.num_leaves, train_set.num_bins,
-        metric_name, float(p0.alpha), num_boost_round, int(bagging_freq),
+        metric_name, float(p0.alpha), float(p0.tweedie_variance_power),
+        num_boost_round, int(bagging_freq),
         n_configs, n_folds, p0.extra.get("hist_impl", "auto"),
         int(p0.extra.get("row_chunk", 131072)),
         p0.extra.get("hist_dtype", "f32"))

@@ -195,3 +195,38 @@ def test_reset_parameter_in_cv():
                  callbacks=[lgb.reset_parameter(
                      learning_rate=lambda i: 0.2 * 0.8 ** i)])
     assert len(res["valid l2-mean"]) == 5
+
+
+def test_early_stopping_min_delta_param():
+    """early_stopping_min_delta: a huge delta stops almost immediately,
+    while delta=0 keeps improving (LightGBM 4.x parameter)."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(1500, 4)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * rng.normal(size=1500)).astype(np.float32)
+    dtrain = lgb.Dataset(X[:1000], label=y[:1000])
+    dvalid = dtrain.create_valid(X[1000:], label=y[1000:])
+    b_strict = lgb.train({"objective": "regression", "verbosity": -1,
+                          "early_stopping_round": 3,
+                          "early_stopping_min_delta": 1e9},
+                         dtrain, num_boost_round=100, valid_sets=[dvalid])
+    b_loose = lgb.train({"objective": "regression", "verbosity": -1,
+                         "early_stopping_round": 3},
+                        dtrain, num_boost_round=100, valid_sets=[dvalid])
+    assert b_strict.best_iteration <= 4
+    assert b_loose.best_iteration > b_strict.best_iteration
+
+
+def test_dataset_feature_num_bin():
+    import numpy as np
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(5)
+    X = np.column_stack([rng.integers(0, 3, 800),
+                         rng.normal(size=800)]).astype(np.float32)
+    ds = lgb.Dataset(X, label=rng.normal(size=800).astype(np.float32))
+    assert ds.feature_num_bin(0) <= 4        # 3 distinct values
+    assert ds.feature_num_bin(1) > 50        # continuous
+    assert len(ds.get_feature_name()) == 2

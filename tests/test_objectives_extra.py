@@ -57,10 +57,19 @@ def test_tweedie_objective(pos_data):
                        + pred ** (2 - rho) / (2 - rho))
 
     assert dev(mu, y) < _const_loss(y, dev) - 1e-3
-    # metric name resolves and appears in eval history
-    res = lgb.cv({"objective": "tweedie", "verbosity": -1},
-                 lgb.Dataset(X, label=y), num_boost_round=5, nfold=3)
-    assert any("tweedie" in k for k in res)
+    # metric name resolves and appears in eval history; the user's rho
+    # reaches the fused-cv metric (code-review r2: it silently used 1.5)
+    res13 = lgb.cv({"objective": "tweedie", "verbosity": -1,
+                    "tweedie_variance_power": 1.3},
+                   lgb.Dataset(X, label=y), num_boost_round=5, nfold=3,
+                   seed=3)
+    res19 = lgb.cv({"objective": "tweedie", "verbosity": -1,
+                    "tweedie_variance_power": 1.9},
+                   lgb.Dataset(X, label=y), num_boost_round=5, nfold=3,
+                   seed=3)
+    key = "valid tweedie-mean"
+    assert key in res13
+    assert not np.allclose(res13[key], res19[key])
 
 
 def test_mape_objective():
