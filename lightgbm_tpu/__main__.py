@@ -1,0 +1,136 @@
+"""Config-file CLI (LightGBM's original interface, ``lightgbm config=...``).
+
+Upstream LightGBM ships a C++ CLI driven by ``key=value`` config files with
+``task=train|predict`` (src/main.cpp + io/config.cpp).  The snippets repo
+never uses it, but it is the library's historical front door, so the same
+contract is exposed here over the TPU engine:
+
+    python -m lightgbm_tpu config=train.conf
+    python -m lightgbm_tpu task=train data=train.csv valid=valid.csv \
+        objective=regression num_trees=100 output_model=model.txt
+    python -m lightgbm_tpu task=predict data=test.csv \
+        input_model=model.txt output_result=preds.txt
+
+Config format (upstream io/config semantics): one ``key = value`` per line,
+``#`` comments; command-line ``key=value`` pairs override file entries.
+Data files are CSV/TSV (auto-sniffed) with ``label_column=<int>`` (default
+0, upstream default) or ``label_column=name:<col>``; ``header=true|false``
+(default false, matching upstream).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def parse_config_text(text: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        if "=" not in line:
+            raise ValueError(f"config line without '=': {line!r}")
+        k, v = line.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out
+
+
+def parse_argv(argv: List[str]) -> Dict[str, str]:
+    """``key=value`` pairs; a ``config=`` file loads first, CLI overrides."""
+    pairs: Dict[str, str] = {}
+    for a in argv:
+        if "=" not in a:
+            raise ValueError(f"expected key=value, got {a!r}")
+        k, v = a.split("=", 1)
+        pairs[k.strip()] = v.strip()
+    cfg: Dict[str, str] = {}
+    if "config" in pairs:
+        with open(pairs.pop("config")) as f:
+            cfg = parse_config_text(f.read())
+    cfg.update(pairs)
+    return cfg
+
+
+def _load_table(path: str, header: bool) -> Tuple[np.ndarray, List[str]]:
+    import csv
+
+    with open(path) as f:
+        sample = f.read(4096)
+        f.seek(0)
+        delim = "\t" if "\t" in sample.split("\n", 1)[0] else ","
+        rows = list(csv.reader(f, delimiter=delim))
+    names: List[str] = []
+    if header:
+        names = rows[0]
+        rows = rows[1:]
+    data = np.asarray(
+        [[np.nan if c in ("", "NA", "na", "NaN") else float(c) for c in r]
+         for r in rows if r], dtype=np.float64)
+    return data, names
+
+
+def _split_label(data: np.ndarray, names: List[str],
+                 label_spec: str) -> Tuple[np.ndarray, np.ndarray]:
+    if label_spec.startswith("name:"):
+        col = names.index(label_spec[5:])
+    else:
+        col = int(label_spec)
+    y = data[:, col]
+    X = np.delete(data, col, axis=1)
+    return X, y
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    cfg = parse_argv(list(sys.argv[1:] if argv is None else argv))
+    task = cfg.pop("task", "train")
+    header = cfg.pop("header", "false").lower() in ("true", "1", "yes")
+    label_spec = cfg.pop("label_column", "0")
+    data_path = cfg.pop("data", None)
+    valid_path = cfg.pop("valid", cfg.pop("valid_data", None))
+    output_model = cfg.pop("output_model", "LightGBM_model.txt")
+    input_model = cfg.pop("input_model", None)
+    output_result = cfg.pop("output_result", "LightGBM_predict_result.txt")
+
+    import lightgbm_tpu as lgb
+
+    if task == "train":
+        if data_path is None:
+            raise SystemExit("task=train requires data=<file>")
+        data, names = _load_table(data_path, header)
+        X, y = _split_label(data, names, label_spec)
+        params = dict(cfg)  # remaining keys ARE the LightGBM params;
+        # train() resolves every num-rounds alias from them itself
+        dtrain = lgb.Dataset(X, label=y)
+        valid_sets = None
+        if valid_path:
+            vdata, vnames = _load_table(valid_path, header)
+            Xv, yv = _split_label(vdata, vnames, label_spec)
+            valid_sets = [dtrain.create_valid(Xv, label=yv)]
+        booster = lgb.train(params, dtrain, valid_sets=valid_sets)
+        booster.save_model(output_model)
+        print(f"[lightgbm_tpu] finished training; model -> {output_model}")
+        return 0
+    if task == "predict":
+        if data_path is None or input_model is None:
+            raise SystemExit(
+                "task=predict requires data=<file> input_model=<model>")
+        data, names = _load_table(data_path, header)
+        booster = lgb.Booster(model_file=input_model)
+        if data.shape[1] == booster.num_feature() + 1:
+            # labelled file: drop the label column like upstream predict
+            X, _ = _split_label(data, names, label_spec)
+        else:
+            X = data
+        pred = booster.predict(X)
+        np.savetxt(output_result, pred, fmt="%.10g")
+        print(f"[lightgbm_tpu] predictions -> {output_result}")
+        return 0
+    raise SystemExit(f"unknown task {task!r} (train|predict)")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

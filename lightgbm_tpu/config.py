@@ -159,6 +159,8 @@ _ALIASES: Dict[str, str] = {
     "use_missing": "use_missing",
     "zero_as_missing": "zero_as_missing",
     "boost_from_average": "boost_from_average",
+    "use_quantized_grad": "use_quantized_grad",
+    "quantized_grad": "use_quantized_grad",
     # objective-specific
     "num_class": "num_class",
     "num_classes": "num_class",
@@ -375,6 +377,11 @@ class Params:
     xgboost_dart_mode: bool = False
     uniform_drop: bool = False
     drop_seed: int = 4
+    # quantized-gradient training (upstream use_quantized_grad): on TPU the
+    # analogous bandwidth/FLOP saving is bf16 histogram inputs on the MXU,
+    # so this flag forces hist_dtype="bf16" (auto already enables it at
+    # >= 2^19 rows)
+    use_quantized_grad: bool = False
     # objective-specific
     boost_from_average: bool = True
     num_class: int = 1

@@ -272,13 +272,15 @@ def run_fused_cv_batch(
         obj.prepare(y_host, w_host)
     init = float(obj.init_score(y_host, w_host))
 
+    from .gbdt import resolve_hist_dtype
+
     run_segment, init_carry, finalize = _fused_cv_fn(
         _objective_static_key(obj, p0), p0.num_leaves, train_set.num_bins,
         metric_name, float(p0.alpha), float(p0.tweedie_variance_power),
         num_boost_round, int(bagging_freq),
         n_configs, n_folds, p0.extra.get("hist_impl", "auto"),
         int(p0.extra.get("row_chunk", 131072)),
-        p0.extra.get("hist_dtype", "f32"))
+        resolve_hist_dtype(p0, n_pad))
 
     tm_d = jnp.asarray(tm)
     carry = init_carry(n_pad, jnp.full((n_configs * n_folds,), init,

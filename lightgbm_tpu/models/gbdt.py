@@ -89,6 +89,10 @@ def resolve_hist_dtype(p: Params, n_rows: int) -> str:
     (validated against f32 AUC on the Higgs bench).  Small data stays at
     true-f32 (Precision.HIGHEST), where exactness is cheap.
     """
+    if p.use_quantized_grad:
+        # upstream's quantized-gradient training: reduced-precision
+        # histogram accumulation; the TPU analogue is bf16 MXU inputs
+        return "bf16"
     d = p.extra.get("hist_dtype", "auto")
     if d != "auto":
         return d
