@@ -107,9 +107,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         dtrain = lgb.Dataset(X, label=y)
         valid_sets = None
         if valid_path:
-            vdata, vnames = _load_table(valid_path, header)
-            Xv, yv = _split_label(vdata, vnames, label_spec)
-            valid_sets = [dtrain.create_valid(Xv, label=yv)]
+            valid_sets = []
+            for vp in valid_path.split(","):  # upstream: comma-separated
+                vdata, vnames = _load_table(vp.strip(), header)
+                Xv, yv = _split_label(vdata, vnames, label_spec)
+                valid_sets.append(dtrain.create_valid(Xv, label=yv))
         booster = lgb.train(params, dtrain, valid_sets=valid_sets)
         booster.save_model(output_model)
         print(f"[lightgbm_tpu] finished training; model -> {output_model}")

@@ -109,6 +109,7 @@ _ALIASES: Dict[str, str] = {
     "monotone_constraining_method": "monotone_constraints_method",
     "mc_method": "monotone_constraints_method",
     "path_smooth": "path_smooth",
+    "interaction_constraints": "interaction_constraints",
     "linear_tree": "linear_tree",
     "linear_trees": "linear_tree",
     "linear_lambda": "linear_lambda",
@@ -340,6 +341,9 @@ class Params:
     monotone_constraints: Optional[List[int]] = None
     monotone_constraints_method: str = "basic"
     path_smooth: float = 0.0
+    # feature groups allowed to interact within one branch (upstream
+    # interaction_constraints); unlisted features become singleton groups
+    interaction_constraints: Optional[List[List[int]]] = None
     # linear leaves (upstream ``linear_tree``): each leaf fits a ridge
     # model over (the first ``linear_k``, a framework key) path features
     linear_tree: bool = False
@@ -411,6 +415,9 @@ class Params:
             extra=dict(self.extra),
             monotone_constraints=(None if self.monotone_constraints is None
                                   else list(self.monotone_constraints)),
+            interaction_constraints=(
+                None if self.interaction_constraints is None
+                else [list(g) for g in self.interaction_constraints]),
         )
 
 
@@ -496,6 +503,20 @@ def parse_params(
             if bv is None:
                 raise ValueError(f"Unknown boosting type: {value!r}")
             out.boosting = bv
+        elif canon == "interaction_constraints":
+            # accepts [[0,1],[2]] or LightGBM's string form "[0,1],[2]"
+            if isinstance(value, str):
+                import re as _re
+                parsed = [[int(x) for x in grp.split(",") if x.strip()]
+                          for grp in _re.findall(r"\[([^\]]*)\]", value)]
+                if not parsed:
+                    raise ValueError(
+                        "interaction_constraints string must contain "
+                        "bracketed groups like '[0,1],[2,3]', got "
+                        f"{value!r}")
+                value = parsed
+            out.interaction_constraints = [
+                [int(f) for f in grp] for grp in value]
         elif canon == "monotone_constraints":
             # accepts LightGBM's "+1,0,-1" string form or any int sequence
             if isinstance(value, str):

@@ -191,7 +191,7 @@ def fused_cv_eligible(p: Params, feval, callbacks, train_set=None) -> bool:
         # the fused while-loop early stop compares without a tolerance
         return False
     if p.monotone_constraints is not None or p.extra_trees \
-            or p.linear_tree:
+            or p.linear_tree or p.interaction_constraints:
         # constrained/randomized split selection needs the per-booster
         # mono_key plumbing; the fused batch program does not trace it yet
         return False

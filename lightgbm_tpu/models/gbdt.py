@@ -196,7 +196,7 @@ def _goss_compact_round(bins, y, w, bag, pred, fmask, hyper: HyperScalars,
                         row_chunk, hist_dtype, wave_width, cat_info,
                         renew_alpha, axis_name=None, sample_key=None,
                         mono=None, extra_trees=False, col_bins=None,
-                        renew_scale=None):
+                        renew_scale=None, ic_member=None):
     """One compacted GOSS round (shared by the per-round and scanned paths
     — the two MUST stay in RNG lockstep for fused == host training).
 
@@ -231,7 +231,8 @@ def _goss_compact_round(bins, y, w, bag, pred, fmask, hyper: HyperScalars,
         hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode, key=key,
         hist_impl=hist_impl, row_chunk=row_chunk, hist_dtype=hist_dtype,
         wave_width=wave_width, cat_info=cat_info, axis_name=axis_name,
-        mono=mono, extra_trees=extra_trees, col_bins=col_bins)
+        mono=mono, extra_trees=extra_trees, col_bins=col_bins,
+        ic_member=ic_member)
     if renew_alpha is not None:
         rw = w[idx] * wt
         if renew_scale is not None:
@@ -251,7 +252,8 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
               cat_key: Optional[tuple] = None,
               mono_key: Optional[tuple] = None, extra_trees: bool = False,
               nbins_key: Optional[tuple] = None,
-              linear_k: Optional[int] = None):
+              linear_k: Optional[int] = None,
+              ic_key: Optional[tuple] = None):
     """goss_k: static (k_top, k_other) row counts enabling the compacted
     GOSS path; None = plain gbdt/rf.  cat_key: static categorical-split
     configuration (see _build_cat_info).  mono_key: static per-feature
@@ -264,6 +266,7 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                 else jnp.asarray(mono_key, jnp.int32))
     colb = (None if nbins_key is None
             else jnp.asarray(nbins_key, jnp.int32))
+    ic_member = (None if ic_key is None else jnp.asarray(ic_key, bool))
 
     def goss_bag(key, g, bag, hyper):
         """GOSS as row re-weighting (multiclass path): top-|g| keep +
@@ -293,7 +296,8 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                     hist_impl=hist_impl, row_chunk=row_chunk,
                     hist_dtype=hist_dtype, wave_width=wave_width,
                     cat_info=_build_cat_info(cat_key, bins.shape[1]),
-                    mono=mono_arr, extra_trees=extra_trees, col_bins=colb)
+                    mono=mono_arr, extra_trees=extra_trees, col_bins=colb,
+                    ic_member=ic_member)
 
             keys = jax.random.split(key, num_class)
             trees, row_leafs = jax.vmap(grow_one, in_axes=(1, 1, 0))(
@@ -317,7 +321,7 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                 hist_dtype, wave_width,
                 _build_cat_info(cat_key, bins.shape[1]), renew_alpha,
                 mono=mono_arr, extra_trees=extra_trees, col_bins=colb,
-                renew_scale=renew_scale)
+                renew_scale=renew_scale, ic_member=ic_member)
 
         return round_fn_goss
 
@@ -341,7 +345,8 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                 key=key, hist_impl=hist_impl, row_chunk=row_chunk,
                 hist_dtype=hist_dtype, wave_width=wave_width,
                 cat_info=_build_cat_info(cat_key, bins.shape[1]),
-                mono=mono_arr, extra_trees=extra_trees, col_bins=colb)
+                mono=mono_arr, extra_trees=extra_trees, col_bins=colb,
+                ic_member=ic_member)
             tree, delta = fit_linear_leaves(
                 tree, row_leaf, xraw, g, h, bag, hyper.linear_lambda,
                 linear_k, row_chunk)
@@ -362,7 +367,8 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
             key=key, hist_impl=hist_impl, row_chunk=row_chunk,
             hist_dtype=hist_dtype, wave_width=wave_width,
             cat_info=_build_cat_info(cat_key, bins.shape[1]),
-            mono=mono_arr, extra_trees=extra_trees, col_bins=colb)
+            mono=mono_arr, extra_trees=extra_trees, col_bins=colb,
+            ic_member=ic_member)
         if renew_alpha is not None:
             rw = w * bag if renew_scale is None else w * bag * renew_scale(y)
             tree = renew_leaf_values(tree, row_leaf, y - pred, rw,
@@ -383,7 +389,8 @@ def _multi_round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                     goss_k: Optional[Tuple[int, int]] = None,
                     mono_key: Optional[tuple] = None,
                     extra_trees: bool = False,
-                    nbins_key: Optional[tuple] = None):
+                    nbins_key: Optional[tuple] = None,
+                    ic_key: Optional[tuple] = None):
     """``n_rounds`` boosting rounds as ONE device program (`lax.scan`).
 
     The host round loop pays a dispatch round-trip per boosting round —
@@ -402,6 +409,7 @@ def _multi_round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                 else jnp.asarray(mono_key, jnp.int32))
     colb = (None if nbins_key is None
             else jnp.asarray(nbins_key, jnp.int32))
+    ic_member = (None if ic_key is None else jnp.asarray(ic_key, bool))
 
     @jax.jit
     def multi(bins, y, w, bag0, pred0, hyper: HyperScalars, round_key,
@@ -435,7 +443,7 @@ def _multi_round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                     goss_k, num_leaves, num_bins, hist_impl, row_chunk,
                     hist_dtype, wave_width, cat_info, renew_alpha,
                     mono=mono_arr, extra_trees=extra_trees, col_bins=colb,
-                    renew_scale=renew_scale)
+                    renew_scale=renew_scale, ic_member=ic_member)
                 return (new_pred, bag), tree
             stats = jnp.stack(
                 [g * bag, h * bag, (bag > 0).astype(jnp.float32)], axis=-1)
@@ -446,7 +454,7 @@ def _multi_round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                 row_chunk=row_chunk, hist_dtype=hist_dtype,
                 wave_width=wave_width,
                 cat_info=cat_info, mono=mono_arr, extra_trees=extra_trees,
-                col_bins=colb)
+                col_bins=colb, ic_member=ic_member)
             if renew_alpha is not None:
                 rw = (w * bag if renew_scale is None
                       else w * bag * renew_scale(y))
@@ -615,10 +623,9 @@ class Booster:
             self.obj.set_group(gs, y_host, int(ds.row_mask.shape[0]))
         k = self._num_class
         if k > 1:
-            if p.boosting in ("rf", "dart"):
+            if p.boosting == "dart":
                 raise NotImplementedError(
-                    f"{p.boosting} boosting with multiclass is not "
-                    "supported yet")
+                    "dart boosting with multiclass is not supported yet")
             self.init_score_ = np.asarray(
                 self.obj.init_score(y_host, w_host), np.float32)  # [K]
             if ds.get_init_score() is not None:
@@ -652,6 +659,7 @@ class Booster:
              float(p.cat_l2), int(p.max_cat_threshold))
             if len(cats) else None)
         self._mono_key = self._resolve_monotone_constraints()
+        self._ic_key = self._resolve_interaction_constraints()
         # per-training-column used-bin counts bound the extra_trees draw
         # (code-review r2: a global [0, num_bins) draw starves
         # low-cardinality features of valid thresholds)
@@ -746,6 +754,48 @@ class Booster:
         self._linear_k = max(1, min(int(p.extra.get("linear_k", 8)),
                                     int(ds.num_feature_)))
 
+    def _resolve_interaction_constraints(self) -> Optional[tuple]:
+        """interaction_constraints (original-feature groups) -> static
+        group-membership over TRAINING columns.
+
+        sklearn-HistGBDT convention: features in no listed group become
+        singleton groups (they can still split, alone).  An EFB bundle
+        column belongs to a group only if ALL its members do (a split on
+        the merged axis involves every member's default/non-default
+        structure)."""
+        p = self.params
+        ic = p.interaction_constraints
+        if not ic:
+            return None
+        bm = self.train_set.bin_mapper
+        f_orig = bm.num_features
+        groups = [set(g) for g in ic]
+        listed = set().union(*groups) if groups else set()
+        for f in sorted(set(range(f_orig)) - listed):
+            groups.append({f})
+        b = bm.bundler
+        cols = ([tuple(g) for g in getattr(b, "groups", [])] if b is not None
+                else [(f,) for f in range(f_orig)])
+        member = [[1 if all(f in g for f in col_members) else 0
+                   for col_members in cols] for g in groups]
+        # EFB fallout: a multi-member bundle column whose members span
+        # groups belongs to no group and would be silently unsplittable
+        # (code-review r2).  If any member is LISTED the semantics are
+        # genuinely mixed -> reject; if all members are unlisted, the
+        # bundle becomes its own singleton group (its members are
+        # mutually-exclusive sparse features).
+        for c, col_members in enumerate(cols):
+            if any(member[g][c] for g in range(len(member))):
+                continue
+            if any(f in listed for f in col_members):
+                raise ValueError(
+                    "interaction_constraints split an EFB bundle "
+                    f"(members {list(col_members)}); pass "
+                    "params={'enable_bundle': False} on the Dataset "
+                    "when constraining sparse features")
+            member.append([1 if i == c else 0 for i in range(len(cols))])
+        return tuple(tuple(row) for row in member)
+
     def _maybe_setup_dp(self) -> None:
         """Shard the training arrays over the local device mesh when the
         user asks for a parallel tree learner (LightGBM ``tree_learner=data``
@@ -802,6 +852,7 @@ class Booster:
                 or getattr(self.obj, "renew_alpha", None) is not None
                 or self._cat_key is not None
                 or self._mono_key is not None or p.extra_trees
+                or self._ic_key is not None
                 or p.feature_fraction_bynode < 1.0):
             warnings.warn(
                 "tree_learner='feature' currently supports single-output "
@@ -1022,7 +1073,7 @@ class Booster:
                 resolve_wave_width(p, eff_rows),
                 resolve_hist_dtype(p, eff_rows), goss_k_shard,
                 self._mono_key, p.extra_trees, self._nbins_key,
-                self._num_class)
+                self._num_class, self._ic_key)
             tree, new_pred = fn(self._dp_bins, self._dp_y, self._dp_w,
                                 self._bag, self._pred_train, fmask,
                                 self._hyper, round_key)
@@ -1034,7 +1085,7 @@ class Booster:
                            resolve_hist_dtype(p, eff_rows),
                            resolve_wave_width(p, eff_rows), goss_k,
                            self._cat_key, self._mono_key, p.extra_trees,
-                           self._nbins_key, self._linear_k)
+                           self._nbins_key, self._linear_k, self._ic_key)
             if self._linear_k is not None:
                 tree, new_pred = fn(ds.X_binned, ds.y, self._w_eff,
                                     self._bag, self._pred_train, fmask,
@@ -1128,7 +1179,7 @@ class Booster:
                 resolve_wave_width(p, eff_rows), n_rounds,
                 p.bagging_freq if use_bagging else 0, use_ff,
                 self._cat_key, goss_k, self._mono_key, p.extra_trees,
-                self._nbins_key)
+                self._nbins_key, self._ic_key)
             pred, bag, trees = fn(
                 ds.X_binned, ds.y, self._w_eff, self._bag, self._pred_train,
                 self._hyper, self._key, bag_key, ff_key, ds.row_mask,
@@ -1202,7 +1253,8 @@ class Booster:
                        int(p.extra.get("row_chunk", 131072)), False, 1,
                        resolve_hist_dtype(p, eff_rows),
                        resolve_wave_width(p, eff_rows), None, self._cat_key,
-                       self._mono_key, p.extra_trees, self._nbins_key)
+                       self._mono_key, p.extra_trees, self._nbins_key,
+                       None, self._ic_key)
         round_key = jax.random.fold_in(self._key, i)
         tree, new_pred = fn(ds.X_binned, ds.y, self._w_eff, self._bag, pred,
                             fmask, self._hyper, round_key)
@@ -1316,6 +1368,14 @@ class Booster:
             if not self.trees:
                 return self._pred_train
             forest = self._stacked_forest()
+            if self._num_class > 1:
+                cols = [predict_forest_binned(
+                    jax.tree.map(lambda a, c=c: a[:, c], forest),
+                    self.train_set.X_binned, 1.0 / self._iter,
+                    float(self.init_score_[c]), jnp.int32(self._iter),
+                    self.params.num_leaves)
+                    for c in range(self._num_class)]
+                return jnp.stack(cols, axis=1)
             pred = predict_forest_binned(
                 forest, self.train_set.X_binned, 1.0 / self._iter,
                 self.init_score_, jnp.int32(self._iter), self.params.num_leaves)
@@ -1471,6 +1531,10 @@ class Booster:
                     min(self._depth_cap, self._forest_depth),
                     start_iteration=jnp.int32(start_iteration)))
             raw = jnp.stack(cols, axis=1)                 # [n, K]
+            if self.params.boosting == "rf" and num_iteration > 0:
+                raw = ((raw - jnp.asarray(self.init_score_)[None, :])
+                       / num_iteration
+                       + jnp.asarray(self.init_score_)[None, :])
         else:
             raw = predict_forest_binned(
                 forest, bins, jnp.float32(shrink), self.init_score_,

@@ -107,6 +107,8 @@ class _GrowState(NamedTuple):
     # categorical candidate splits (None when the dataset has none)
     cand_cat: Optional[jnp.ndarray] = None      # bool[M]
     cand_catmask: Optional[jnp.ndarray] = None  # bool[M, B]
+    # interaction constraints: surviving group set per node (None = off)
+    ic_sets: Optional[jnp.ndarray] = None       # bool[M, NG]
 
 
 def _write(arr, idx, val, active):
@@ -127,6 +129,19 @@ def _rand_bins_for_node(key, node_id, num_features, num_bins, col_bins):
     hi = (jnp.asarray(col_bins, jnp.float32) - 1.0 if col_bins is not None
           else jnp.float32(max(num_bins - 1, 1)))
     return jnp.floor(u * jnp.maximum(hi, 1.0)).astype(jnp.int32)
+
+
+def _ic_allowed(group_sets, member):
+    """Interaction constraints: allowed-feature mask for nodes.
+
+    ``group_sets`` bool [..., NG] — which constraint groups the node's
+    path-used feature set still fits inside (upstream col_sampler's
+    interaction-constraint tracking, re-derived as a set recurrence:
+    ``S_child = {G in S_node : split_feature in G}``).  ``member`` bool
+    [NG, F].  Allowed features = union of the surviving groups — one
+    boolean matmul."""
+    return (group_sets.astype(jnp.float32) @ member.astype(jnp.float32)
+            > 0.5).astype(jnp.float32)
 
 
 def _mono_child_bounds(mono, feat, wl, wr, lo, hi):
@@ -279,6 +294,7 @@ def grow_tree(
     mono=None,
     extra_trees: bool = False,
     col_bins=None,
+    ic_member=None,
 ) -> Tuple[Tree, jnp.ndarray]:
     """Grow one best-first tree.
 
@@ -324,7 +340,7 @@ def grow_tree(
             wave_width, ff_bynode=ff_bynode, key=key, axis_name=axis_name,
             hist_impl=hist_impl, row_chunk=row_chunk, hist_dtype=hist_dtype,
             cat_info=cat_info, mono=mono, extra_trees=extra_trees,
-            col_bins=col_bins)
+            col_bins=col_bins, ic_member=ic_member)
     n, num_features = bins.shape
     capacity = 2 * num_leaves - 1
     max_depth = jnp.asarray(max_depth, jnp.int32)
@@ -369,9 +385,15 @@ def grow_tree(
         root_tot[0], root_tot[1], root_tot[2],
         ctx._replace(path_smooth=jnp.float32(0.0)),
         jnp.float32(-jnp.inf), jnp.float32(jnp.inf), jnp.float32(0.0))
+    if ic_member is not None:
+        ng = ic_member.shape[0]
+        root_sets = jnp.ones((ng,), bool)
+        root_mask = node_feature_mask(0) * _ic_allowed(root_sets, ic_member)
+    else:
+        root_mask = node_feature_mask(0)
     # LightGBM convention: max_depth <= 0 means unlimited, so the root
     # (depth 0) is always splittable — if a limit exists it is >= 1.
-    root_best = find_best_split(root_hist, ctx, node_feature_mask(0),
+    root_best = find_best_split(root_hist, ctx, root_mask,
                                 jnp.bool_(True), cat_info, mono=mono,
                                 parent_out=root_out,
                                 rand_bins=node_rand_bins(0))
@@ -413,6 +435,9 @@ def grow_tree(
         cand_catmask=(None if cat_info is None else
                       jnp.zeros((capacity, num_bins), jnp.bool_)
                       .at[0].set(root_best.cat_mask)),
+        ic_sets=(None if ic_member is None else
+                 jnp.zeros((capacity, ic_member.shape[0]), bool)
+                 .at[0].set(True)),
     )
 
     bins_i32 = bins.astype(jnp.int32)
@@ -459,6 +484,10 @@ def grow_tree(
         child_depth = st.depth[leaf] + 1
         depth_ok = (max_depth <= 0) | (child_depth < max_depth)
         child_masks = jnp.stack([node_feature_mask(nl), node_feature_mask(nr)])
+        if ic_member is not None:
+            child_sets = st.ic_sets[leaf] & ic_member[:, feat]   # [NG]
+            child_masks = child_masks * _ic_allowed(child_sets,
+                                                    ic_member)[None, :]
         child_lo = jnp.stack([lo_l, lo_r])
         child_hi = jnp.stack([hi_l, hi_r])
         child_out = jnp.stack([wl_v, wr_v])
@@ -538,6 +567,9 @@ def grow_tree(
             cand_catmask=(None if cat_info is None else _write(
                 _write(st.cand_catmask, nl, bs.cat_mask[0], active),
                 nr, bs.cat_mask[1], active)),
+            ic_sets=(None if ic_member is None else _write(
+                _write(st.ic_sets, nl, child_sets, active),
+                nr, child_sets, active)),
         )
         return new
 
@@ -608,6 +640,8 @@ class _WaveState(NamedTuple):
     # categorical candidate splits (None when the dataset has none)
     cand_cat: Optional[jnp.ndarray] = None      # bool[M]
     cand_catmask: Optional[jnp.ndarray] = None  # bool[M, B]
+    # interaction constraints: surviving group set per node (None = off)
+    ic_sets: Optional[jnp.ndarray] = None       # bool[M, NG]
 
 
 def grow_tree_frontier(
@@ -629,6 +663,7 @@ def grow_tree_frontier(
     mono=None,
     extra_trees: bool = False,
     col_bins=None,
+    ic_member=None,
 ) -> Tuple[Tree, jnp.ndarray]:
     """Best-first growth in WAVES: up to ``wave_width`` splits per data pass.
 
@@ -696,7 +731,13 @@ def grow_tree_frontier(
         root_tot[0], root_tot[1], root_tot[2],
         ctx._replace(path_smooth=jnp.float32(0.0)),
         jnp.float32(-jnp.inf), jnp.float32(jnp.inf), jnp.float32(0.0))
-    root_best = find_best_split(root_hist, ctx, node_feature_mask(0),
+    if ic_member is not None:
+        root_mask_f = (node_feature_mask(0)
+                       * _ic_allowed(jnp.ones((ic_member.shape[0],), bool),
+                                     ic_member))
+    else:
+        root_mask_f = node_feature_mask(0)
+    root_best = find_best_split(root_hist, ctx, root_mask_f,
                                 jnp.bool_(True), cat_info, mono=mono,
                                 parent_out=root_out,
                                 rand_bins=node_rand_bins(0))
@@ -738,6 +779,9 @@ def grow_tree_frontier(
         cand_catmask=(None if cat_info is None else
                       jnp.zeros((capacity, num_bins), jnp.bool_)
                       .at[0].set(root_best.cat_mask)),
+        ic_sets=(None if ic_member is None else
+                 jnp.zeros((capacity, ic_member.shape[0]), bool)
+                 .at[0].set(True)),
     )
 
     bins_i32 = bins.astype(jnp.int32)
@@ -825,6 +869,12 @@ def grow_tree_frontier(
         child_depth = jnp.concatenate([child_depth1, child_depth1])
         depth_ok = (max_depth <= 0) | (child_depth < max_depth)
         child_masks = jax.vmap(node_feature_mask)(child_nodes)
+        if ic_member is not None:
+            child_sets = (st.ic_sets[parent_r]
+                          & ic_member[:, pf].T)              # [W, NG]
+            allowed_w = _ic_allowed(child_sets, ic_member)   # [W, F]
+            child_masks = child_masks * jnp.concatenate(
+                [allowed_w, allowed_w])
         child_lo = jnp.concatenate([lo_l, lo_r])
         child_hi = jnp.concatenate([hi_l, hi_r])
         child_vals = jnp.concatenate([wl_w, wr_w])        # actual outputs
@@ -892,6 +942,9 @@ def grow_tree_frontier(
                 st.cand_cat, child_nodes, bs.cat, active_2)),
             cand_catmask=(None if cat_info is None else _scatter(
                 st.cand_catmask, child_nodes, bs.cat_mask, active_2)),
+            ic_sets=(None if ic_member is None else _scatter(
+                st.ic_sets, child_nodes,
+                jnp.concatenate([child_sets, child_sets]), active_2)),
         )
 
     st = lax.while_loop(cond, body, st)

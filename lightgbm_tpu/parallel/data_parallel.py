@@ -80,7 +80,7 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                        wave_width: int = 1, hist_dtype: str = "f32",
                        goss_k_shard=None, mono_key=None,
                        extra_trees: bool = False, nbins_key=None,
-                       num_class: int = 1):
+                       num_class: int = 1, ic_key=None):
     """Build the jitted data-parallel round step for a mesh.
 
     Returns step(bins, y, w, bag, pred, feature_mask, hyper) ->
@@ -100,6 +100,7 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                 else jnp.asarray(mono_key, jnp.int32))
     colb = (None if nbins_key is None
             else jnp.asarray(nbins_key, jnp.int32))
+    ic_member = (None if ic_key is None else jnp.asarray(ic_key, bool))
 
     def step_mc(bins, y, w, bag, pred, feature_mask, hyper: HyperScalars,
                 key):
@@ -130,7 +131,8 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                 axis_name=DATA_AXIS, hist_impl=hist_impl,
                 row_chunk=row_chunk, hist_dtype=hist_dtype,
                 wave_width=wave_width, mono=mono_arr,
-                extra_trees=extra_trees, col_bins=colb)
+                extra_trees=extra_trees, col_bins=colb,
+                ic_member=ic_member)
 
         keys = jax.random.split(key, num_class)
         trees, row_leafs = jax.vmap(grow_one, in_axes=(1, 1, 0))(g, h, keys)
@@ -154,7 +156,8 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                 g, h, goss_k_shard, num_leaves, num_bins, hist_impl,
                 row_chunk, hist_dtype, wave_width, None, None,
                 axis_name=DATA_AXIS, sample_key=sample_key,
-                mono=mono_arr, extra_trees=extra_trees, col_bins=colb)
+                mono=mono_arr, extra_trees=extra_trees, col_bins=colb,
+                ic_member=ic_member)
             return tree, new_pred
         stats = jnp.stack([g * bag, h * bag, bag], axis=-1)
         tree, row_leaf = grow_tree(
@@ -163,7 +166,7 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
             key=key, axis_name=DATA_AXIS, hist_impl=hist_impl,
             row_chunk=row_chunk, hist_dtype=hist_dtype,
             wave_width=wave_width, mono=mono_arr, extra_trees=extra_trees,
-            col_bins=colb)
+            col_bins=colb, ic_member=ic_member)
         shrink = jnp.where(is_rf, 1.0, hyper.learning_rate)
         new_pred = pred + shrink * tree.leaf_value[row_leaf]
         return tree, new_pred

@@ -193,3 +193,59 @@ def test_monotone_with_goss_and_dp_mesh(mono_data):
                         "monotone_constraints": [1, -1, 0, 0]},
                        ds, num_boost_round=10)
         assert _monotonicity_violations(b2, X, 0, +1) == 0
+
+
+def _branch_feature_sets(booster):
+    """Per-leaf sets of ORIGINAL features used on the root path."""
+    sets = []
+    for info in booster.dump_model()["tree_info"]:
+        def rec(node, used):
+            if "leaf_value" in node:
+                if used:
+                    sets.append(frozenset(used))
+                return
+            u2 = used | {node["split_feature"]}
+            rec(node["left_child"], u2)
+            rec(node["right_child"], u2)
+        rec(info["tree_structure"], set())
+    return sets
+
+
+def test_interaction_constraints_respected():
+    rng = np.random.default_rng(17)
+    n = 4000
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    # truth mixes (x0,x2) and (x1,x3) — the constraint forbids exactly that
+    y = (X[:, 0] * X[:, 2] + X[:, 1] * X[:, 3]
+         + 0.1 * rng.normal(size=n)).astype(np.float32)
+    groups = [[0, 1], [2, 3]]
+    ds = lgb.Dataset(X, label=y)
+    for policy in ("leafwise", "frontier"):
+        b = lgb.train({"objective": "regression", "verbosity": -1,
+                       "grow_policy": policy, "num_leaves": 15,
+                       "interaction_constraints": groups},
+                      ds, num_boost_round=15)
+        for used in _branch_feature_sets(b):
+            assert (used <= {0, 1}) or (used <= {2, 3}), (policy, used)
+    # sanity: unconstrained DOES mix groups on this data
+    b0 = lgb.train({"objective": "regression", "verbosity": -1,
+                    "num_leaves": 15}, ds, num_boost_round=15)
+    assert any(not (u <= {0, 1}) and not (u <= {2, 3})
+               for u in _branch_feature_sets(b0))
+
+
+def test_interaction_constraints_singletons_and_string():
+    rng = np.random.default_rng(18)
+    n = 2000
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (X[:, 0] + X[:, 2] + 0.1 * rng.normal(size=n)).astype(np.float32)
+    # only [0,1] listed: feature 2 becomes a singleton group (sklearn
+    # convention) — usable alone, never together with others
+    b = lgb.train({"objective": "regression", "verbosity": -1,
+                   "num_leaves": 7,
+                   "interaction_constraints": "[0,1]"},
+                  ds := lgb.Dataset(X, label=y), num_boost_round=10)
+    for used in _branch_feature_sets(b):
+        assert used <= {0, 1} or used == {2}, used
+    # feature 2 is still used somewhere (it carries signal)
+    assert b.feature_importance()[2] > 0

@@ -108,3 +108,23 @@ def test_multiclass_refit(blobs):
     # refit on the training slice itself keeps accuracy in range
     acc = np.mean(np.argmax(ref.predict(X[1400:]), axis=1) == y[1400:])
     assert acc > 0.8, acc
+
+
+def test_multiclass_random_forest(blobs):
+    """boosting='rf' with multiclass: per-class forests averaged (upstream
+    supports rf for any objective); probabilities stay normalized."""
+    X, y = blobs
+    booster = lgb.train({"objective": "multiclass", "num_class": 3,
+                         "boosting": "rf", "bagging_fraction": 0.7,
+                         "bagging_freq": 1, "num_leaves": 15,
+                         "verbosity": -1},
+                        lgb.Dataset(X[:1200], label=y[:1200]),
+                        num_boost_round=20)
+    proba = booster.predict(X[1200:1500])
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+    acc = float(np.mean(np.argmax(proba, axis=1) == y[1200:1500]))
+    assert acc > 0.8, acc
+    # staged predict still averages over the PREFIX forest
+    p5 = booster.predict(X[1200:1210], num_iteration=5)
+    np.testing.assert_allclose(p5.sum(axis=1), 1.0, rtol=1e-5)
+    assert not np.allclose(p5, proba[:10])
