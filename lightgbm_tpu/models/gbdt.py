@@ -771,6 +771,11 @@ class Booster:
         f_orig = bm.num_features
         groups = [set(g) for g in ic]
         listed = set().union(*groups) if groups else set()
+        bad = sorted(f for f in listed if not (0 <= f < f_orig))
+        if bad:
+            raise ValueError(
+                f"interaction_constraints reference feature indices {bad} "
+                f"but the dataset has {f_orig} features")
         for f in sorted(set(range(f_orig)) - listed):
             groups.append({f})
         b = bm.bundler
@@ -809,14 +814,19 @@ class Booster:
         import warnings
 
         p = self.params
+        ranking = getattr(self.obj, "needs_group", False)
         if (p.boosting == "dart" or p.linear_tree
-                or getattr(self.obj, "needs_group", False)
                 or getattr(self.obj, "renew_alpha", None) is not None
-                or self._cat_key is not None):
+                or self._cat_key is not None
+                or (ranking and (p.boosting != "gbdt"
+                                 or self._mono_key is not None
+                                 or self._ic_key is not None
+                                 or p.extra_trees))):
             warnings.warn(
                 f"tree_learner='{p.tree_learner}' currently supports "
-                "non-ranking gbdt/rf/goss boosting without leaf renewal "
-                "or categorical splits; training serially", stacklevel=3)
+                "gbdt/rf/goss boosting without leaf renewal or "
+                "categorical splits (ranking: plain gbdt only); training "
+                "serially", stacklevel=3)
             return
         n_pad = int(self.train_set.row_mask.shape[0])
         n_dev = len(jax.devices())
@@ -832,6 +842,14 @@ class Booster:
 
         self._dp_mesh = make_mesh(n_dev)
         ds = self.train_set
+        if ranking:
+            # LambdaRank lambdas need whole queries: the [Q, G] pairwise
+            # pass runs REPLICATED (cheap next to histogram work) and only
+            # the grower is sharded — see make_dp_grow_step.
+            self._dp_stats_only = True
+            self._dp_bins = shard_rows(self._dp_mesh, ds.X_binned)
+            self._dp_grad_jit = jax.jit(self.obj.grad_hess)
+            return
         (self._dp_bins, self._dp_y, self._dp_w, self._pred_train,
          self._bag) = shard_rows(
             self._dp_mesh, ds.X_binned, ds.y, self._w_eff,
@@ -1053,6 +1071,26 @@ class Booster:
             tree, new_pred = fn(self._fp_bins, ds.y, self._w_eff, self._bag,
                                 self._pred_train, fmask_p, self._hyper,
                                 round_key)
+        elif getattr(self, "_dp_mesh", None) is not None and \
+                getattr(self, "_dp_stats_only", False):
+            from ..parallel.data_parallel import (make_dp_grow_step,
+                                                  shard_rows)
+
+            g, h = self._dp_grad_jit(self._pred_train, ds.y, self._w_eff)
+            bag = self._bag
+            stats = jnp.stack(
+                [g * bag, h * bag, (bag > 0).astype(jnp.float32)], axis=-1)
+            stats = shard_rows(self._dp_mesh, stats)
+            fn = make_dp_grow_step(
+                self._dp_mesh, p.num_leaves, self._num_bins,
+                p.extra.get("hist_impl", "auto"),
+                int(p.extra.get("row_chunk", 131072)),
+                resolve_wave_width(p, eff_rows),
+                resolve_hist_dtype(p, eff_rows))
+            tree = fn(self._dp_bins, stats, fmask, self._hyper, round_key)
+            add = _tree_pred_fn(p.num_leaves, 1)
+            new_pred = add(self._pred_train, tree, ds.X_binned,
+                           jnp.float32(p.learning_rate))
         elif getattr(self, "_dp_mesh", None) is not None:
             from ..parallel.data_parallel import make_dp_train_step
 

@@ -187,3 +187,38 @@ def dp_full_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
     """One full training step (grad->tree->update) for dry-run validation."""
     return make_dp_train_step(mesh, obj_key, num_leaves, num_bins,
                               wave_width=wave_width)
+
+
+@functools.lru_cache(maxsize=None)
+def make_dp_grow_step(mesh: Mesh, num_leaves: int, num_bins: int,
+                      hist_impl: str = "auto", row_chunk: int = 131072,
+                      wave_width: int = 1, hist_dtype: str = "f32"):
+    """Data-parallel growth from PRECOMPUTED per-row stats.
+
+    The ranking path: LambdaRank gradients need whole queries (the [Q, G]
+    pairwise pass), so they are computed replicated — cheap next to the
+    histogram work — and only the grower runs sharded with psum-merged
+    histograms (upstream's data-parallel ranking keeps whole queries per
+    machine; here the query pass is replicated instead, same result).
+
+    step(bins_sharded, stats_sharded, feature_mask, hyper, key) ->
+    tree (replicated).
+    """
+
+    def step(bins, stats, feature_mask, hyper: HyperScalars, key):
+        tree, _row_leaf = grow_tree(
+            bins, stats, feature_mask, hyper.ctx(), num_leaves, num_bins,
+            hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
+            key=key, axis_name=DATA_AXIS, hist_impl=hist_impl,
+            row_chunk=row_chunk, hist_dtype=hist_dtype,
+            wave_width=wave_width)
+        return tree
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(), P()),
+        out_specs=P(),
+        check_vma=False,  # tree replicated by construction via psum
+    )
+    return jax.jit(sharded)

@@ -80,6 +80,7 @@ def _clear_all_jit_caches():
     try:
         from lightgbm_tpu.parallel import data_parallel as _dp
         _dp.make_dp_train_step.cache_clear()
+        _dp.make_dp_grow_step.cache_clear()
     except Exception:
         pass
     try:

@@ -260,3 +260,29 @@ def test_dp_multiclass_goss_trains():
     p = b.predict(X[:50])
     assert p.shape == (50, K)
     np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_dp_lambdarank_matches_serial():
+    """tree_learner='data' with lambdarank: lambdas computed replicated
+    (whole queries), growth sharded with psum-merged histograms — must
+    match serial training."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(23)
+    n_q, g_sz = 64, 16
+    n = n_q * g_sz
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    rel = np.clip((X[:, 0] + 0.5 * X[:, 1]
+                   + 0.3 * rng.normal(size=n)) * 1.2 + 1.5, 0, 4)
+    y = np.floor(rel).astype(np.float32)
+    group = np.full(n_q, g_sz)
+    params = {"objective": "lambdarank", "num_leaves": 7, "verbosity": -1,
+              "min_data_in_leaf": 5}
+    b_s = lgb.train(params, lgb.Dataset(X, label=y, group=group),
+                    num_boost_round=5)
+    b_d = lgb.train({**params, "tree_learner": "data"},
+                    lgb.Dataset(X, label=y, group=group),
+                    num_boost_round=5)
+    np.testing.assert_allclose(b_s.predict(X[:100]), b_d.predict(X[:100]),
+                               rtol=1e-4, atol=1e-5)
