@@ -1087,10 +1087,10 @@ class Booster:
                 int(p.extra.get("row_chunk", 131072)),
                 resolve_wave_width(p, eff_rows),
                 resolve_hist_dtype(p, eff_rows))
-            tree = fn(self._dp_bins, stats, fmask, self._hyper, round_key)
-            add = _tree_pred_fn(p.num_leaves, 1)
-            new_pred = add(self._pred_train, tree, ds.X_binned,
-                           jnp.float32(p.learning_rate))
+            tree, row_leaf = fn(self._dp_bins, stats, fmask, self._hyper,
+                                round_key)
+            new_pred = self._pred_train + jnp.float32(p.learning_rate) \
+                * tree.leaf_value[row_leaf]
         elif getattr(self, "_dp_mesh", None) is not None:
             from ..parallel.data_parallel import make_dp_train_step
 

@@ -202,23 +202,25 @@ def make_dp_grow_step(mesh: Mesh, num_leaves: int, num_bins: int,
     machine; here the query pass is replicated instead, same result).
 
     step(bins_sharded, stats_sharded, feature_mask, hyper, key) ->
-    tree (replicated).
+    (tree [replicated], row_leaf [row-sharded]) — callers update train
+    predictions with one ``leaf_value[row_leaf]`` gather instead of
+    re-traversing the tree (code-review r2).
     """
 
     def step(bins, stats, feature_mask, hyper: HyperScalars, key):
-        tree, _row_leaf = grow_tree(
+        tree, row_leaf = grow_tree(
             bins, stats, feature_mask, hyper.ctx(), num_leaves, num_bins,
             hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
             key=key, axis_name=DATA_AXIS, hist_impl=hist_impl,
             row_chunk=row_chunk, hist_dtype=hist_dtype,
             wave_width=wave_width)
-        return tree
+        return tree, row_leaf
 
     sharded = jax.shard_map(
         step,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(), P()),
-        out_specs=P(),
+        out_specs=(P(), P(DATA_AXIS)),
         check_vma=False,  # tree replicated by construction via psum
     )
     return jax.jit(sharded)
