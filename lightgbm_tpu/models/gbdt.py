@@ -492,6 +492,23 @@ def _tree_pred_fn(depth_cap: int, num_class: int = 1):
     return add_tree
 
 
+def _predict_forest_mc(forest, bins, shrink, inits, n_trees, depth_cap,
+                       start_iteration=0):
+    """Per-class forest replay for multiclass tree stacks ([T, K, M]
+    fields) -> raw scores [n, K].  The single shared implementation of the
+    class-sliced predict_forest_binned loop (used by predict, the lazy rf
+    train-pred reconstruction, and DART's dropped-tree sums)."""
+    k = forest.leaf_value.shape[1]
+    cols = [predict_forest_binned(
+        jax.tree.map(lambda a, c=c: a[:, c], forest), bins,
+        jnp.float32(shrink),
+        float(inits[c]) if np.ndim(inits) else float(inits),
+        jnp.int32(n_trees), depth_cap,
+        start_iteration=jnp.int32(start_iteration))
+        for c in range(k)]
+    return jnp.stack(cols, axis=1)
+
+
 @functools.lru_cache(maxsize=None)
 def _linear_tree_pred_fn(depth_cap: int):
     """pred += shrink * (leaf_const + coef . raw_pathfeats) for ONE linear
@@ -622,10 +639,7 @@ class Booster:
                     "information: Dataset(X, label=y, group=sizes)")
             self.obj.set_group(gs, y_host, int(ds.row_mask.shape[0]))
         k = self._num_class
-        if k > 1:
-            if p.boosting == "dart":
-                raise NotImplementedError(
-                    "dart boosting with multiclass is not supported yet")
+        if k > 1:  # every boosting mode (gbdt/goss/rf/dart) supports K>1
             self.init_score_ = np.asarray(
                 self.obj.init_score(y_host, w_host), np.float32)  # [K]
             if ds.get_init_score() is not None:
@@ -1261,8 +1275,9 @@ class Booster:
                     int(t) for t in rng.choice(dropped, p.max_drop,
                                                replace=False))
         k = len(dropped)
+        nc = self._num_class
         lr = jnp.float32(p.learning_rate)
-        add = _tree_pred_fn(self._depth_cap, 1)
+        add = _tree_pred_fn(self._depth_cap, nc)
 
         drop_sum = None
         if k > 0:
@@ -1276,6 +1291,9 @@ class Booster:
                 *[pad_tree(self.trees[t], cap) for t in dropped])
 
             def dropped_sum(bins):
+                if nc > 1:  # [k, K, M] stacked trees -> [n, K] summed raw
+                    return _predict_forest_mc(stack, bins, 1.0, 0.0, k,
+                                              self._depth_cap)
                 return predict_forest_binned(
                     stack, bins, 1.0, 0.0, jnp.int32(k), self._depth_cap)
 
@@ -1288,7 +1306,7 @@ class Booster:
         eff_rows = int(ds.row_mask.shape[0])
         fn = _round_fn(self._obj_key, p.num_leaves, self._num_bins,
                        p.extra.get("hist_impl", "auto"),
-                       int(p.extra.get("row_chunk", 131072)), False, 1,
+                       int(p.extra.get("row_chunk", 131072)), False, nc,
                        resolve_hist_dtype(p, eff_rows),
                        resolve_wave_width(p, eff_rows), None, self._cat_key,
                        self._mono_key, p.extra_trees, self._nbins_key,
@@ -1407,13 +1425,9 @@ class Booster:
                 return self._pred_train
             forest = self._stacked_forest()
             if self._num_class > 1:
-                cols = [predict_forest_binned(
-                    jax.tree.map(lambda a, c=c: a[:, c], forest),
-                    self.train_set.X_binned, 1.0 / self._iter,
-                    float(self.init_score_[c]), jnp.int32(self._iter),
-                    self.params.num_leaves)
-                    for c in range(self._num_class)]
-                return jnp.stack(cols, axis=1)
+                return _predict_forest_mc(
+                    forest, self.train_set.X_binned, 1.0 / self._iter,
+                    self.init_score_, self._iter, self.params.num_leaves)
             pred = predict_forest_binned(
                 forest, self.train_set.X_binned, 1.0 / self._iter,
                 self.init_score_, jnp.int32(self._iter), self.params.num_leaves)
@@ -1560,15 +1574,10 @@ class Booster:
         forest = self._stacked_forest()
         k = self._num_class
         if k > 1:
-            cols = []
-            for c in range(k):
-                forest_c = jax.tree.map(lambda a: a[:, c], forest)
-                cols.append(predict_forest_binned(
-                    forest_c, bins, jnp.float32(shrink),
-                    float(self.init_score_[c]), jnp.int32(num_iteration),
-                    min(self._depth_cap, self._forest_depth),
-                    start_iteration=jnp.int32(start_iteration)))
-            raw = jnp.stack(cols, axis=1)                 # [n, K]
+            raw = _predict_forest_mc(
+                forest, bins, shrink, self.init_score_, num_iteration,
+                min(self._depth_cap, self._forest_depth),
+                start_iteration=start_iteration)          # [n, K]
             if self.params.boosting == "rf" and num_iteration > 0:
                 raw = ((raw - jnp.asarray(self.init_score_)[None, :])
                        / num_iteration

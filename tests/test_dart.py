@@ -66,3 +66,30 @@ def test_dart_with_valid_set_early_stopping(reg_data):
     fresh = b.predict(X[va], num_iteration=b.num_trees())
     np.testing.assert_allclose(
         np.asarray(vpred)[: len(va)], fresh, rtol=1e-4, atol=1e-5)
+
+
+def test_dart_multiclass():
+    """DART with multiclass: per-class trees dropped/rescaled together
+    (the drop set is per ROUND, matching upstream's round-level dropout)."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(31)
+    n, K = 1500, 3
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.argmax(X[:, :K] + 0.5 * rng.normal(size=(n, K)),
+                  axis=1).astype(np.float32)
+    b = lgb.train({"objective": "multiclass", "num_class": K,
+                   "boosting": "dart", "drop_rate": 0.3, "skip_drop": 0.0,
+                   "num_leaves": 7, "verbosity": -1},
+                  lgb.Dataset(X[:1200], label=y[:1200]),
+                  num_boost_round=15)
+    proba = b.predict(X[1200:])
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+    acc = float(np.mean(np.argmax(proba, axis=1) == y[1200:]))
+    assert acc > 0.65, acc
+    # the maintained train predictions match a fresh predict (drop/rescale
+    # bookkeeping is consistent)
+    tp = np.asarray(b._pred_train)[:1200]
+    pp = b.predict(X[:1200], raw_score=True)
+    np.testing.assert_allclose(tp, pp, rtol=2e-3, atol=2e-3)
