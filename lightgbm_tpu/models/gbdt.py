@@ -1779,12 +1779,16 @@ class Booster:
         return self
 
     def refit(self, data, label, decay_rate: float = 0.9,
-              weight=None, **kwargs) -> "Booster":
+              weight=None, group=None, **kwargs) -> "Booster":
         """Refit leaf values on new data, keeping every tree's structure
         (LightGBM ``Booster.refit``): sequentially per tree, the new leaf
         value is ``decay_rate * old + (1 - decay_rate) * newton`` where the
         Newton step comes from the new data's grad/hess at the ensemble's
         running prediction.  Returns a NEW booster; self is untouched.
+
+        Ranking models pass ``group=`` (query sizes of the NEW data) — a
+        fresh lambda layout is packed for it and the pairwise gradients
+        drive the same Newton renewal.
         """
         import copy as _copy
 
@@ -1796,10 +1800,6 @@ class Booster:
             raise NotImplementedError(
                 "refit with linear_tree is not supported (leaf models need "
                 "re-solving, not Newton-constant renewal)")
-        if getattr(self.obj, "needs_group", False):
-            raise NotImplementedError(
-                "refit with group objectives (lambdarank) needs regrouped "
-                "data; not supported yet")
         if kwargs:
             raise TypeError(f"refit got unsupported arguments: "
                             f"{sorted(kwargs)}")
@@ -1815,6 +1815,18 @@ class Booster:
         decay = jnp.float32(decay_rate)
         lr = jnp.float32(getattr(self, "_base_lr", p.learning_rate))
         obj = self.obj
+        if getattr(obj, "needs_group", False):
+            if group is None:
+                raise ValueError(
+                    "refit with a ranking objective requires group= "
+                    "(query sizes of the refit data)")
+            # fresh lambda layout packed for the NEW data
+            obj = create_objective(p)
+            obj.set_group(np.asarray(group, np.int64).reshape(-1),
+                          np.asarray(label, np.float32),
+                          int(np.asarray(label).reshape(-1).shape[0]))
+        elif group is not None:
+            raise TypeError("refit got group= for a non-ranking objective")
         depth_cap = self._depth_cap
 
         def leaf_of(tree):

@@ -153,3 +153,31 @@ def test_truncation_level_changes_gradients():
     assert not np.allclose(g_full, g_t1)
     # gradients sum to ~0 per query (pairwise antisymmetry)
     assert abs(g_full.sum()) < 1e-2
+
+
+def test_lambdarank_refit_with_group():
+    import numpy as np
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(41)
+    n_q, g_sz = 48, 12
+    n = n_q * g_sz
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.clip(np.floor(X[:, 0] + 0.3 * rng.normal(size=n)) + 2,
+                0, 4).astype(np.float32)
+    group = np.full(n_q, g_sz)
+    b = lgb.train({"objective": "lambdarank", "num_leaves": 7,
+                   "verbosity": -1},
+                  lgb.Dataset(X, label=y, group=group), num_boost_round=6)
+    # refit on the second half (regrouped)
+    half = n // 2
+    ref = b.refit(X[half:], y[half:], group=np.full(n_q // 2, g_sz),
+                  decay_rate=0.5)
+    for t0, t1 in zip(b.trees, ref.trees):
+        np.testing.assert_array_equal(np.asarray(t0.split_feature),
+                                      np.asarray(t1.split_feature))
+    assert not np.allclose(np.asarray(b.trees[0].leaf_value),
+                           np.asarray(ref.trees[0].leaf_value))
+    import pytest
+    with pytest.raises(ValueError, match="group="):
+        b.refit(X[half:], y[half:])
