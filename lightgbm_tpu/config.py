@@ -298,6 +298,7 @@ _FRAMEWORK_KEYS = {
     "cv_segment_rounds",   # fused-cv rounds per device dispatch
     "fobj",                # custom objective callable
     "wave_width",          # frontier grower: max splits per histogram pass
+    "wave_tail",           # "half" (near-strict tail) | "greedy" (fewest passes)
     "linear_k",            # linear_tree: max path features per leaf model
 }
 

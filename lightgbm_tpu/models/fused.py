@@ -45,6 +45,7 @@ from jax import lax
 from ..config import Params, default_metric_for_objective
 from ..metrics import get_metric
 from .gbdt import HyperScalars, _objective_static_key, _rebuild_objective
+from ..ops.lookup import lookup_values
 from .tree import grow_tree
 
 
@@ -74,7 +75,8 @@ def _fused_cv_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                  metric_name: str, metric_alpha: float,
                  metric_rho: float, t_max: int,
                  bagging_freq: int, n_configs: int, n_folds: int,
-                 hist_impl: str, row_chunk: int, hist_dtype: str = "f32"):
+                 hist_impl: str, row_chunk: int, hist_dtype: str = "f32",
+                 cat_key: Optional[tuple] = None):
     """Build the jitted fused-cv program for one static configuration."""
     obj = _rebuild_objective(obj_key)
     metric = get_metric(metric_name,
@@ -86,6 +88,8 @@ def _fused_cv_fn(obj_key: tuple, num_leaves: int, num_bins: int,
     def one_element_round(bins, y, w, pred, bag, hyper: HyperScalars, ff,
                           key):
         """One boosting round for one (config, fold) batch element."""
+        from .gbdt import _build_cat_info
+
         num_features = bins.shape[1]
         g, h = obj.grad_hess(pred, y, w)
         stats = jnp.stack([g * bag, h * bag, bag], axis=-1)
@@ -95,8 +99,10 @@ def _fused_cv_fn(obj_key: tuple, num_leaves: int, num_bins: int,
             bins, stats, fmask, hyper.ctx(), num_leaves, num_bins,
             hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
             key=jax.random.fold_in(key, 2), hist_impl=hist_impl,
-            row_chunk=row_chunk, hist_dtype=hist_dtype)
-        return pred + hyper.learning_rate * tree.leaf_value[row_leaf]
+            row_chunk=row_chunk, hist_dtype=hist_dtype,
+            cat_info=_build_cat_info(cat_key, num_features))
+        return pred + hyper.learning_rate * lookup_values(
+            row_leaf, tree.leaf_value)
 
     @jax.jit
     def run_segment(carry: FusedCVCarry, seg_end, bins, y, w, train_masks,
@@ -195,8 +201,6 @@ def fused_cv_eligible(p: Params, feval, callbacks, train_set=None) -> bool:
         # constrained/randomized split selection needs the per-booster
         # mono_key plumbing; the fused batch program does not trace it yet
         return False
-    if train_set is not None and bool(np.any(train_set.col_is_categorical)):
-        return False
     return True
 
 
@@ -274,13 +278,17 @@ def run_fused_cv_batch(
 
     from .gbdt import resolve_hist_dtype
 
+    cats = np.flatnonzero(train_set.col_is_categorical)
+    cat_key = ((tuple(int(c) for c in cats), float(p0.cat_smooth),
+                float(p0.cat_l2), int(p0.max_cat_threshold))
+               if len(cats) else None)
     run_segment, init_carry, finalize = _fused_cv_fn(
         _objective_static_key(obj, p0), p0.num_leaves, train_set.num_bins,
         metric_name, float(p0.alpha), float(p0.tweedie_variance_power),
         num_boost_round, int(bagging_freq),
         n_configs, n_folds, p0.extra.get("hist_impl", "auto"),
         int(p0.extra.get("row_chunk", 131072)),
-        resolve_hist_dtype(p0, n_pad))
+        resolve_hist_dtype(p0, n_pad), cat_key)
 
     tm_d = jnp.asarray(tm)
     carry = init_carry(n_pad, jnp.full((n_configs * n_folds,), init,

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.histogram import compute_histograms, histogram_psum
+from ..ops.lookup import lookup_rows, lookup_values
 from ..ops.split import (
     BestSplit,
     SplitContext,
@@ -295,6 +296,7 @@ def grow_tree(
     extra_trees: bool = False,
     col_bins=None,
     ic_member=None,
+    wave_tail: str = "half",
 ) -> Tuple[Tree, jnp.ndarray]:
     """Grow one best-first tree.
 
@@ -329,10 +331,16 @@ def grow_tree(
       (Tree, row_leaf) — row_leaf gives each training row's final leaf node id
       so the boosting loop can update train predictions with one gather.
 
-    ``wave_width > 1`` dispatches to :func:`grow_tree_frontier` (multiple
+    ``|wave_width| > 1`` dispatches to :func:`grow_tree_frontier` (multiple
     splits per histogram pass via the subtraction trick — the large-data
-    fast path).
+    fast path).  A NEGATIVE ``wave_width`` selects the "greedy" wave tail
+    (spend the whole remaining leaf budget per wave — fewest histogram
+    passes); positive keeps the "half" tail (near-strict tail ordering).
+    The sign encoding lets the policy ride every existing static plumbing
+    path (compile-cache keys, mesh learners) untouched.
     """
+    if wave_width < 0:
+        wave_width, wave_tail = -wave_width, "greedy"
     if wave_width > 1 and fp_axis is None:
         # (the frontier grower runs data-parallel but not feature-parallel)
         return grow_tree_frontier(
@@ -340,7 +348,7 @@ def grow_tree(
             wave_width, ff_bynode=ff_bynode, key=key, axis_name=axis_name,
             hist_impl=hist_impl, row_chunk=row_chunk, hist_dtype=hist_dtype,
             cat_info=cat_info, mono=mono, extra_trees=extra_trees,
-            col_bins=col_bins, ic_member=ic_member)
+            col_bins=col_bins, ic_member=ic_member, wave_tail=wave_tail)
     n, num_features = bins.shape
     capacity = 2 * num_leaves - 1
     max_depth = jnp.asarray(max_depth, jnp.int32)
@@ -664,6 +672,7 @@ def grow_tree_frontier(
     extra_trees: bool = False,
     col_bins=None,
     ic_member=None,
+    wave_tail: str = "half",
 ) -> Tuple[Tree, jnp.ndarray]:
     """Best-first growth in WAVES: up to ``wave_width`` splits per data pass.
 
@@ -800,13 +809,19 @@ def grow_tree_frontier(
             lax.iota(jnp.int32, m))
         budget = num_leaves - st.n_leaves
         n_cand = jnp.sum(jnp.isfinite(gains)).astype(jnp.int32)
-        # Spend at most HALF the remaining leaf budget per wave: early waves
-        # stay wide (throughput), but near budget exhaustion waves shrink to
-        # 1 so the final splits are allocated (near-)strict-best-first —
-        # this is what keeps wave-grown trees at strict-growth quality when
-        # the budget binds (leaf-wise growth's whole advantage).
-        half = jnp.maximum(jnp.int32(1), budget // 2)
-        s = jnp.minimum(jnp.minimum(n_cand, half),
+        # Wave size: every histogram pass costs the same (the one-hot
+        # matmul pads the segment lanes to a full MXU tile), so wave count
+        # IS tree cost.  Greedy (s = min(budget, W)) closes a 127-leaf tree
+        # in 8 passes; spending at most HALF the remaining budget per wave
+        # allocates the tail splits near-strict-best-first at ~5 extra
+        # passes.  The tail refinement is what preserves strict-growth
+        # quality when the leaf budget nearly saturates the data (small-n /
+        # large-num_leaves); ``wave_tail`` picks the tradeoff.
+        if wave_tail == "half":
+            alloc = jnp.maximum(jnp.int32(1), budget // 2)
+        else:  # "greedy"
+            alloc = budget
+        s = jnp.minimum(jnp.minimum(n_cand, alloc),
                         jnp.int32(w_width))               # splits this wave
         sel = jnp.isfinite(gains) & (rank < s)            # [M]
 
@@ -814,30 +829,60 @@ def grow_tree_frontier(
         nl_of = st.n_nodes + 2 * rank
         nr_of = nl_of + 1
 
-        # 2. partition rows of all splitting leaves at once.
-        p = st.row_leaf
-        psel = sel[p]
-        feat_r = st.cand_feat[p]
-        thr_r = st.cand_bin[p]
-        v = jnp.take_along_axis(bins_i32, feat_r[:, None], axis=1)[:, 0]
-        if cat_info is None:
-            go_left = v <= thr_r
-        else:
-            go_left = jnp.where(st.cand_cat[p], st.cand_catmask[p, v],
-                                v <= thr_r)
-        child = jnp.where(go_left, nl_of[p], nr_of[p])
-        row_leaf = jnp.where(psel, child, p)
-
-        # 3. one histogram pass over the SMALLER child of every split.
+        # 2. partition rows of all splitting leaves at once.  Per-row state
+        # comes from ONE one-hot-matmul table lookup (ops.lookup): XLA's
+        # native [n]-from-[capacity] gathers cost ~7 ms each at 1M rows on
+        # TPU, and this block needs six of them — more than the histogram
+        # kernel itself.
         parent_r = order[:w_width]                        # [W] node ids
         active_r = iota_w < s
         direct_left = st.cand_lc[parent_r] <= st.cand_rc[parent_r]
         nl_r = st.n_nodes + 2 * iota_w
         nr_r = nl_r + 1
-        direct_node = jnp.where(direct_left, nl_r, nr_r)
-        seg_of_node = _scatter(full(w_width, jnp.int32), direct_node,
-                               iota_w, active_r)
-        seg_id = seg_of_node[row_leaf]
+        dl_of = _scatter(full(m, jnp.bool_), parent_r, direct_left,
+                         active_r)                        # node -> direct side
+        p = st.row_leaf
+        f32 = jnp.float32
+        cols = [sel.astype(f32), st.cand_feat.astype(f32),
+                st.cand_bin.astype(f32), nl_of.astype(f32),
+                nr_of.astype(f32), dl_of.astype(f32), rank.astype(f32)]
+        if cat_info is not None:
+            cols.append(st.cand_cat.astype(f32))
+        # DEFAULT precision (native-rate bf16 dot) is exact only while every
+        # table value is an integer <= 256 (bf16 has an 8-bit significand);
+        # feature ids beyond 256 or node ids beyond 256 (num_leaves >= 129)
+        # need the full-precision dot or rows partition on corrupted ids
+        exact_in_bf16 = max(num_features, capacity, num_bins) <= 256
+        pv = lookup_rows(p, jnp.stack(cols, axis=1),
+                         precision=(lax.Precision.DEFAULT if exact_in_bf16
+                                    else lax.Precision.HIGHEST))
+        psel = pv[:, 0] > 0
+        feat_r = pv[:, 1].astype(jnp.int32)
+        thr_r = pv[:, 2]
+        # per-row split value WITHOUT take_along_axis (same gather problem):
+        # masked lane-reduction over the feature axis
+        fmatch = feat_r[:, None] == lax.iota(jnp.int32, num_features)[None, :]
+        v = jnp.sum(jnp.where(fmatch, bins_i32, 0), axis=1)
+        if cat_info is None:
+            go_left = v.astype(f32) <= thr_r
+        else:
+            # category-subset membership: one-hot lookup of the row's mask
+            # row, then select bit v — both stay fused elementwise/matmul
+            mrow = lookup_rows(p, st.cand_catmask.astype(f32),
+                               precision=lax.Precision.DEFAULT)  # [n, B]
+            bit = jnp.sum(
+                jnp.where(v[:, None] == lax.iota(jnp.int32, num_bins)[None, :],
+                          mrow, 0.0), axis=1)
+            go_left = jnp.where(pv[:, 7] > 0, bit > 0,
+                                v.astype(f32) <= thr_r)
+        child = jnp.where(go_left, pv[:, 3], pv[:, 4]).astype(jnp.int32)
+        row_leaf = jnp.where(psel, child, p)
+
+        # 3. one histogram pass over the SMALLER child of every split: a row
+        # participates iff its leaf splits this wave AND it went to the
+        # direct (smaller) side; its segment is the leaf's wave rank.
+        to_direct = psel & (go_left == (pv[:, 5] > 0))
+        seg_id = jnp.where(to_direct, pv[:, 6].astype(jnp.int32), w_width)
         direct_hist = hist_fn(seg_id, w_width)            # [W, F, B, 3]
 
         # 4. sibling = parent - child (the subtraction trick).

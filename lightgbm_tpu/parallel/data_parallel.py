@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.gbdt import HyperScalars, _rebuild_objective
+from ..ops.lookup import lookup_values
 from ..models.tree import Tree, grow_tree
 
 DATA_AXIS = "data"
@@ -80,7 +81,7 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                        wave_width: int = 1, hist_dtype: str = "f32",
                        goss_k_shard=None, mono_key=None,
                        extra_trees: bool = False, nbins_key=None,
-                       num_class: int = 1, ic_key=None):
+                       num_class: int = 1, ic_key=None, cat_key=None):
     """Build the jitted data-parallel round step for a mesh.
 
     Returns step(bins, y, w, bag, pred, feature_mask, hyper) ->
@@ -95,12 +96,18 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
     GOSS, which samples per machine) and the compacted shards' histograms
     psum-merge as usual.
     """
+    from ..models.gbdt import _build_cat_info
+
     obj = _rebuild_objective(obj_key)
     mono_arr = (None if mono_key is None
                 else jnp.asarray(mono_key, jnp.int32))
     colb = (None if nbins_key is None
             else jnp.asarray(nbins_key, jnp.int32))
     ic_member = (None if ic_key is None else jnp.asarray(ic_key, bool))
+    # categorical k-vs-rest splits work unchanged under the mesh: the scan
+    # runs on psum-MERGED histograms (replicated, so every shard picks the
+    # same subset mask) and the partition gathers per-shard rows
+    make_cat = lambda nf: _build_cat_info(cat_key, nf)  # noqa: E731
 
     def step_mc(bins, y, w, bag, pred, feature_mask, hyper: HyperScalars,
                 key):
@@ -132,11 +139,12 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                 row_chunk=row_chunk, hist_dtype=hist_dtype,
                 wave_width=wave_width, mono=mono_arr,
                 extra_trees=extra_trees, col_bins=colb,
-                ic_member=ic_member)
+                ic_member=ic_member, cat_info=make_cat(bins.shape[1]))
 
         keys = jax.random.split(key, num_class)
         trees, row_leafs = jax.vmap(grow_one, in_axes=(1, 1, 0))(g, h, keys)
-        deltas = jax.vmap(lambda t, rl: t.leaf_value[rl])(trees, row_leafs)
+        deltas = jax.vmap(lambda t, rl: lookup_values(
+            rl, t.leaf_value))(trees, row_leafs)
         return trees, pred + hyper.learning_rate * deltas.T
 
     def step(bins, y, w, bag, pred, feature_mask, hyper: HyperScalars, key):
@@ -154,7 +162,8 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
             tree, new_pred = _goss_compact_round(
                 bins, y, w, bag, pred, feature_mask, hyper, key,
                 g, h, goss_k_shard, num_leaves, num_bins, hist_impl,
-                row_chunk, hist_dtype, wave_width, None, None,
+                row_chunk, hist_dtype, wave_width,
+                make_cat(bins.shape[1]), None,
                 axis_name=DATA_AXIS, sample_key=sample_key,
                 mono=mono_arr, extra_trees=extra_trees, col_bins=colb,
                 ic_member=ic_member)
@@ -166,9 +175,10 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
             key=key, axis_name=DATA_AXIS, hist_impl=hist_impl,
             row_chunk=row_chunk, hist_dtype=hist_dtype,
             wave_width=wave_width, mono=mono_arr, extra_trees=extra_trees,
-            col_bins=colb, ic_member=ic_member)
+            col_bins=colb, ic_member=ic_member,
+            cat_info=make_cat(bins.shape[1]))
         shrink = jnp.where(is_rf, 1.0, hyper.learning_rate)
-        new_pred = pred + shrink * tree.leaf_value[row_leaf]
+        new_pred = pred + shrink * lookup_values(row_leaf, tree.leaf_value)
         return tree, new_pred
 
     sharded = jax.shard_map(

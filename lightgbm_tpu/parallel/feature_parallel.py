@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.gbdt import HyperScalars, _rebuild_objective
+from ..ops.lookup import lookup_values
 from ..models.tree import grow_tree
 
 FEATURE_AXIS = "feature"
@@ -86,7 +87,7 @@ def make_fp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
             key=key, hist_impl=hist_impl, row_chunk=row_chunk,
             hist_dtype=hist_dtype, wave_width=1, fp_axis=FEATURE_AXIS)
         shrink = jnp.where(is_rf, 1.0, hyper.learning_rate)
-        new_pred = pred + shrink * tree.leaf_value[row_leaf]
+        new_pred = pred + shrink * lookup_values(row_leaf, tree.leaf_value)
         return tree, new_pred
 
     sharded = jax.shard_map(

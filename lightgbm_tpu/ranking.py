@@ -235,6 +235,35 @@ def _ndcg_eval_fn(k: int):
     return fn
 
 
+def map_at_k(scores: jnp.ndarray, rel: jnp.ndarray, valid: jnp.ndarray,
+             k: int) -> jnp.ndarray:
+    """Per-query MAP@k (upstream ``rank_metric.hpp`` MapMetric semantics):
+    binary relevance (label > 0), AP@k = sum over relevant hits in the top-k
+    of hits_so_far/position, normalized by min(num_relevant, k); queries with
+    no relevant docs count as 1 (same degenerate-query convention the NDCG
+    metric uses). [Q, G] dense layout."""
+    masked = jnp.where(valid, scores, -jnp.inf)
+    order = jnp.argsort(-masked, axis=-1, stable=True)
+    rel_sorted = jnp.take_along_axis(rel & valid, order, axis=-1)
+    kk = min(k, rel.shape[-1])
+    hits = jnp.cumsum(rel_sorted.astype(jnp.float32), axis=-1)[:, :kk]
+    pos = 1.0 + lax.iota(jnp.float32, kk)
+    acc = jnp.sum(jnp.where(rel_sorted[:, :kk], hits / pos, 0.0), axis=-1)
+    npos = jnp.sum((rel & valid).astype(jnp.float32), axis=-1)
+    denom = jnp.minimum(npos, float(kk))
+    return jnp.where(npos > 0, acc / jnp.maximum(denom, 1.0), 1.0)
+
+
+@functools.lru_cache(maxsize=None)
+def _map_eval_fn(k: int):
+    @jax.jit
+    def fn(scores, rel, valid, qweight):
+        per_q = map_at_k(scores, rel, valid, k)
+        return jnp.sum(per_q * qweight) / jnp.maximum(jnp.sum(qweight), 1e-12)
+
+    return fn
+
+
 class RankEvalContext:
     """Per-dataset packed layout for ranking metrics, built once."""
 
@@ -248,6 +277,8 @@ class RankEvalContext:
         self.valid = jnp.asarray(valid)
         self.gains = jnp.asarray(np.where(valid, table[labels.astype(np.int64)],
                                           0.0), jnp.float32)
+        # binary relevance for MAP: label > 0 (upstream MapMetric threshold)
+        self.rel = jnp.asarray(np.where(valid, labels > 0, False))
         self.qweight = jnp.ones(doc_idx.shape[0], jnp.float32)
 
     def ndcg(self, pred_raw: jnp.ndarray, k: int) -> float:
@@ -255,18 +286,34 @@ class RankEvalContext:
         return float(_ndcg_eval_fn(int(k))(scores, self.gains, self.valid,
                                            self.qweight))
 
+    def map(self, pred_raw: jnp.ndarray, k: int) -> float:
+        scores = pred_raw[self.doc_idx]
+        return float(_map_eval_fn(int(k))(scores, self.rel, self.valid,
+                                          self.qweight))
+
 
 def eval_ranking(pred_raw, ds, eval_at: List[int],
-                 label_gain: Optional[List[float]] = None):
-    """[(name, value, higher_better)] for ndcg@k over a grouped Dataset."""
+                 label_gain: Optional[List[float]] = None,
+                 metrics: Tuple[str, ...] = ("ndcg",)):
+    """[(name, value, higher_better)] for ndcg@k / map@k over a grouped
+    Dataset (upstream ``rank_metric.hpp`` NDCGMetric / MapMetric)."""
     ctx = getattr(ds, "_rank_eval_ctx", None)
     if ctx is None:
         gs = ds.get_group()
         if gs is None:
-            raise ValueError("ndcg metric requires the Dataset to have group")
+            raise ValueError(
+                "ranking metrics require the Dataset to have group")
         ctx = RankEvalContext(gs, ds.get_label(), label_gain)
         ds._rank_eval_ctx = ctx
-    return [(f"ndcg@{k}", ctx.ndcg(pred_raw, k), True) for k in eval_at]
+    out = []
+    for m in metrics:
+        if m == "ndcg":
+            out.extend((f"ndcg@{k}", ctx.ndcg(pred_raw, k), True)
+                       for k in eval_at)
+        elif m == "map":
+            out.extend((f"map@{k}", ctx.map(pred_raw, k), True)
+                       for k in eval_at)
+    return out
 
 
 def get_ranking_metric(name: str, params=None) -> Metric:

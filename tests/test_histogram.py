@@ -54,3 +54,35 @@ def test_histogram_zero_stats_rows_contribute_nothing(rng):
                              jnp.asarray(seg), 1, B)
     # every feature's histogram accumulates all contributing rows once
     assert float(np.asarray(got).sum()) == 25.0 * F
+
+
+@pytest.mark.parametrize("mode", ["f32", "bf16"])
+def test_fused_pallas_matches_numpy(rng, mode):
+    from lightgbm_tpu.ops.histogram_pallas import hist_fused_pallas
+
+    n, F, B, K = 1500, 4, 32, 5
+    bins = rng.integers(0, B, (n, F)).astype(np.uint8)
+    stats = rng.normal(0, 1, (n, 3)).astype(np.float32)
+    seg = rng.integers(-1, K + 1, n).astype(np.int32)  # out-of-range dropped
+    got = hist_fused_pallas(jnp.asarray(bins), jnp.asarray(stats),
+                            jnp.asarray(seg), K, B, hist_dtype=mode)
+    want = _numpy_hist(bins, stats, seg, K, B)
+    tol = 2e-2 if mode == "bf16" else 1e-3
+    np.testing.assert_allclose(np.asarray(got), want, rtol=tol, atol=tol)
+
+
+def test_fused_pallas_feature_blocking(rng):
+    """Wide-feature shapes split the feature axis into grid blocks (the
+    [F, B, K] accumulator must fit VMEM — MSLR has 136 features)."""
+    from lightgbm_tpu.ops.histogram_pallas import hist_fused_pallas
+
+    # F=136, B=256, K=42*3 -> a ~17.5 MB accumulator: must split into
+    # (at least) two feature blocks to fit the 16 MB VMEM scope
+    n, F, B, K = 700, 136, 256, 42
+    bins = rng.integers(0, B, (n, F)).astype(np.uint8)
+    stats = rng.normal(0, 1, (n, 3)).astype(np.float32)
+    seg = rng.integers(0, K, n).astype(np.int32)
+    got = hist_fused_pallas(jnp.asarray(bins), jnp.asarray(stats),
+                            jnp.asarray(seg), K, B, hist_dtype="f32")
+    want = _numpy_hist(bins, stats, seg, K, B)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-3, atol=1e-3)

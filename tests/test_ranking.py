@@ -181,3 +181,65 @@ def test_lambdarank_refit_with_group():
     import pytest
     with pytest.raises(ValueError, match="group="):
         b.refit(X[half:], y[half:])
+
+
+def _map_oracle(scores, y, sizes, k):
+    """Numpy AP@k per query: binary relevance label>0, denominator
+    min(num_relevant, k); empty-relevance queries count 1."""
+    out = []
+    start = 0
+    for s in sizes:
+        sc, yy = scores[start:start + s], y[start:start + s]
+        start += s
+        order = np.argsort(-sc, kind="stable")
+        rel = (yy[order] > 0).astype(np.float64)
+        npos = rel.sum()
+        if npos == 0:
+            out.append(1.0)
+            continue
+        hits = np.cumsum(rel)[:k]
+        r = rel[:k]
+        ap = np.sum(r * hits / (1.0 + np.arange(len(r)))) / min(npos, k)
+        out.append(ap)
+    return float(np.mean(out))
+
+
+def test_map_matches_numpy_oracle():
+    X, y, sizes = make_ranked(n_queries=50, seed=7)
+    rng = np.random.default_rng(1)
+    scores = rng.normal(0, 1, len(y))
+    ds = lgb.Dataset(X, label=y, group=sizes)
+    ds.construct()
+    for k in (1, 3, 5, 10):
+        got = eval_ranking(jnp.asarray(scores, jnp.float32), ds, [k],
+                           metrics=("map",))
+        assert got[0][0] == f"map@{k}"
+        assert got[0][1] == pytest.approx(
+            _map_oracle(scores, y, sizes, k), abs=1e-5)
+
+
+def test_map_eval_and_early_stopping():
+    X, y, sizes = make_ranked(n_queries=60, seed=3)
+    Xv, yv, sv = make_ranked(n_queries=20, seed=4)
+    ds = lgb.Dataset(X, label=y, group=sizes)
+    dv = lgb.Dataset(Xv, label=yv, group=sv)
+    booster = lgb.train(dict(objective="lambdarank", num_leaves=15,
+                             min_data_in_leaf=5, verbosity=-1,
+                             metric=["map"], eval_at=[5]),
+                        ds, num_boost_round=8, valid_sets=[dv],
+                        valid_names=["va"])
+    res = booster.eval_valid()
+    assert {r[1] for r in res} == {"map@5"}
+    assert all(0.0 <= r[2] <= 1.0 for r in res)
+
+    # early stopping driven by map must engage (higher_better respected)
+    evals = {}
+    booster2 = lgb.train(dict(objective="lambdarank", num_leaves=15,
+                              min_data_in_leaf=5, verbosity=-1,
+                              metric=["map"], eval_at=[5],
+                              early_stopping_rounds=3),
+                         ds, num_boost_round=200, valid_sets=[dv],
+                         valid_names=["va"],
+                         callbacks=[lgb.record_evaluation(evals)])
+    assert booster2.best_iteration >= 1
+    assert len(evals["va"]["map@5"]) < 200  # stopped early
