@@ -178,6 +178,7 @@ def bench_higgs(n=1_000_000, n_rounds=100, num_leaves=127, oracle=True):
         "wall_rows_per_s": round(wall_rows_per_s, 1),
         "auc_tpu": round(auc_tpu, 5),
     }
+
     if oracle:
         from sklearn.ensemble import HistGradientBoostingClassifier
 

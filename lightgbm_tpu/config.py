@@ -296,6 +296,7 @@ _FRAMEWORK_KEYS = {
     "hist_impl",           # "auto" | "jnp" | "pallas"
     "row_chunk",           # histogram row-chunk size
     "cv_segment_rounds",   # fused-cv rounds per device dispatch
+    "fused_segment_rounds",  # update_many rounds per device dispatch
     "fobj",                # custom objective callable
     "wave_width",          # frontier grower: max splits per histogram pass
     "wave_tail",           # "half" (near-strict tail) | "greedy" (fewest passes)
@@ -382,10 +383,11 @@ class Params:
     xgboost_dart_mode: bool = False
     uniform_drop: bool = False
     drop_seed: int = 4
-    # quantized-gradient training (upstream use_quantized_grad): on TPU the
-    # analogous bandwidth/FLOP saving is bf16 histogram inputs on the MXU,
-    # so this flag forces hist_dtype="bf16" (auto already enables it at
-    # >= 2^19 rows)
+    # quantized-gradient training (upstream use_quantized_grad): maps to
+    # bf16 histogram inputs — the FAST reduced-precision mode on this chip.
+    # A true int8 path (8-bit stochastic rounding + exact int32 MXU
+    # accumulation) exists behind hist_dtype="int8" but measured SLOWER
+    # than bf16 (Mosaic int8 relayouts force a 4x smaller row chunk)
     use_quantized_grad: bool = False
     # objective-specific
     boost_from_average: bool = True

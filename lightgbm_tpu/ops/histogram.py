@@ -44,6 +44,9 @@ def _hist_one_chunk(bins_c: jnp.ndarray, segstats_c: jnp.ndarray,
     """
     if hist_dtype == "bf16":
         segstats_c = segstats_c.astype(jnp.bfloat16)
+    # "int8" is a pallas-kernel-only mode; this XLA path runs it at full
+    # precision (same results, no quantization) rather than erroring so
+    # hist_impl="jnp"/CPU fallbacks stay usable
 
     def per_feature(_, bins_f):
         # one-hot built ALREADY TRANSPOSED [B, n]: the contraction then runs

@@ -181,33 +181,37 @@ def _fused_kernel(bins_ref, stats_ref, seg_ref, out_ref, *,
     w = num_segments
     stats = stats_ref[:]                                   # [chunk, S] f32
     seg = seg_ref[:]                                       # [chunk, 1] i32
-    # 2-D-only fold (Mosaic cannot collapse a non-lane-aligned minor dim):
-    # lane k of the folded tile is stats[:, k % S] masked to seg == k // S.
+    # 2-D-only fold (Mosaic cannot collapse a non-lane-aligned minor dim,
+    # and lane-tiling ops like jnp.tile pad each S-lane segment to a full
+    # 128-lane tile — measured 19-43 MB of scoped VMEM): lane k of the
+    # folded tile is stats[:, k % S] masked to seg == k // S, built as a
+    # tiny [S, W*S] selection matmul + a 2-D mask.
     iota_k = lax.broadcasted_iota(jnp.int32, (chunk, w * s), 1)
     seg_match = seg == iota_k // s                          # [chunk, W*S]
-    # stat-broadcast matrix P[s, k] = (k % S == s): st @ P replicates each
-    # stat column into its W lanes with one tiny [S, W*S] matmul
     proj = (lax.broadcasted_iota(jnp.int32, (s, w * s), 1) % s
             == lax.broadcasted_iota(jnp.int32, (s, w * s), 0))
 
-    def fold(st):
-        """[chunk, S] -> bf16 [chunk, W*S] (k = seg*S + stat); inputs are
-        exactly bf16-representable so the final cast is lossless."""
+    def fold(st, out_t):
         spread = lax.dot_general(
             st.astype(jnp.float32), proj.astype(jnp.float32),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return jnp.where(seg_match, spread, 0.0).astype(jnp.bfloat16)
+        return jnp.where(seg_match, spread, 0.0).astype(out_t)
 
-    if hist_dtype == "bf16":
-        operands = (fold(stats.astype(jnp.bfloat16)),
-                    jnp.zeros((chunk, w * s), jnp.bfloat16))
-        passes = 1
+    if hist_dtype == "int8":
+        # stats arrive PRE-QUANTIZED to integers in [-127, 127] (stored as
+        # f32, exactly representable) — the dot runs at the MXU's
+        # double-rate int8 path with EXACT int32 accumulation
+        operands = (fold(stats, jnp.int8),)
+        oh_t, acc_t = jnp.int8, jnp.int32
+    elif hist_dtype == "bf16":
+        operands = (fold(stats, jnp.bfloat16),)
+        oh_t, acc_t = jnp.bfloat16, jnp.float32
     else:  # "f32": exact-to-~16-bit hi/lo split, two native-rate passes
-        hi = stats.astype(jnp.bfloat16)
-        lo = (stats - hi.astype(jnp.float32)).astype(jnp.bfloat16)
-        operands = (fold(hi), fold(lo))
-        passes = 2
+        hi = stats.astype(jnp.bfloat16).astype(jnp.float32)
+        lo = stats - hi
+        operands = (fold(hi, jnp.bfloat16), fold(lo, jnp.bfloat16))
+        oh_t, acc_t = jnp.bfloat16, jnp.float32
 
     iota_bt = lax.broadcasted_iota(jnp.int32, (num_bins, chunk), 0)
 
@@ -216,16 +220,16 @@ def _fused_kernel(bins_ref, stats_ref, seg_ref, out_ref, *,
     # [F_blk, chunk] so the dynamic per-feature slice is on the major dim
     def body(f, _):
         codes_t = bins_ref[pl.dslice(f, 1), :]             # [1, chunk] i32
-        onehot_t = (iota_bt == codes_t).astype(jnp.bfloat16)
+        onehot_t = (iota_bt == codes_t).astype(oh_t)
         tile = lax.dot_general(
             onehot_t, operands[0],
             dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        if passes == 2:
+            preferred_element_type=acc_t)
+        if len(operands) == 2:
             tile = tile + lax.dot_general(
                 onehot_t, operands[1],
                 dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+                preferred_element_type=acc_t)
         out_ref[pl.dslice(f, 1), :, :] += tile[None]
         return _
 
@@ -254,8 +258,14 @@ def hist_fused_pallas(
     # — stats/seg tiles are re-read once per block, a negligible cost next
     # to the matmul.
     f_blk = num_features
-    while f_blk > 1 and f_blk * num_bins * k * 4 > 8 * 1024 * 1024:
+    while f_blk > 1 and f_blk * num_bins * k * 4 > 6 * 1024 * 1024:
         f_blk = -(-f_blk // 2)
+    if f_blk != num_features:
+        # blocked second-to-last dims must be multiples of 8 (Mosaic
+        # tiling); round DOWN so the VMEM budget the loop just enforced
+        # cannot be re-violated (rounding up re-grew a 34-feature block to
+        # 40 and overflowed the 16 MB scope at the MSLR shape)
+        f_blk = max(8, f_blk // 8 * 8)
     n_fblk = -(-num_features // f_blk)
     f_pad = n_fblk * f_blk - num_features
     if chunk is None:
@@ -265,10 +275,14 @@ def hist_fused_pallas(
         # a too-small chunk costs a few % of MXU efficiency, a too-big one
         # fails compile
         out_bytes = f_blk * num_bins * k * 4
-        budget = 13 * 1024 * 1024 - out_bytes
-        per_row = 2 * num_bins + 14 * k + 8 * f_blk + 64
+        budget = 11 * 1024 * 1024 - out_bytes
+        per_row = 4 * num_bins + 20 * k + 8 * f_blk + 64
         chunk = max(512, min(2048, budget // max(per_row, 1)))
         chunk = int(chunk) // 512 * 512 or 512
+        if hist_dtype == "int8":
+            # Mosaic widens int8 intermediates aggressively (measured 43 MB
+            # of scoped VMEM at chunk=2048 vs ~14 MB for the bf16 path)
+            chunk = 512
     # transposed [F, n] i32 layout: the kernel's per-feature dynamic slice
     # must be on the MAJOR dim.  This is loop-invariant across the grower's
     # waves, so XLA hoists the transpose out of the growth while_loop.
@@ -288,6 +302,27 @@ def hist_fused_pallas(
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
 
+    scales = None
+    if hist_dtype == "int8":
+        # per-channel symmetric quantization to [-127, 127] with
+        # deterministic per-row stochastic rounding (the TPU analogue of
+        # LightGBM's ``use_quantized_grad`` gradient discretization):
+        # unbiased E[q] = x/scale, exact int32 accumulation on the MXU at
+        # double the bf16 rate
+        scales = jnp.maximum(jnp.max(jnp.abs(stats), axis=0),
+                             1e-30) / 127.0                 # [S]
+        idx = lax.iota(jnp.uint32, stats.shape[0])
+        r = (((idx * jnp.uint32(2654435761) + jnp.uint32(974711))
+              >> jnp.uint32(9)).astype(jnp.float32)
+             / jnp.float32(1 << 23))                        # U[0,1) per row
+        # clip: the channel-max row has x/scale ~= 127 + ulp noise, and
+        # with r -> 1 the floor can land on +128 — out of int8 range.
+        # int32 accumulation overflow bound: a (segment, bin) cell wraps
+        # past 2^31 / 127 ~= 16.9M rows; fine for the 11M north star, a
+        # documented cliff beyond.
+        stats = jnp.clip(jnp.floor(stats / scales[None, :] + r[:, None]),
+                         -127.0, 127.0)
+
     out = pl.pallas_call(
         functools.partial(_fused_kernel, num_features=num_features,
                           num_bins=num_bins, num_segments=num_segments,
@@ -304,10 +339,13 @@ def hist_fused_pallas(
         out_specs=pl.BlockSpec((f_blk, num_bins, k),
                                lambda fb, c: (fb, 0, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((n_fblk * f_blk, num_bins, k),
-                                       jnp.float32),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_fblk * f_blk, num_bins, k),
+            jnp.int32 if hist_dtype == "int8" else jnp.float32),
         interpret=interpret,
     )(bins_t, stats, seg_id.reshape(-1, 1))
     out = out[:num_features]
-    return out.reshape(num_features, num_bins, num_segments, s).transpose(
-        2, 0, 1, 3)
+    out = out.reshape(num_features, num_bins, num_segments, s)
+    if scales is not None:
+        out = out.astype(jnp.float32) * scales[None, None, None, :]
+    return out.transpose(2, 0, 1, 3)

@@ -110,3 +110,63 @@ def _bounded_compile_state():
     _test_counter[0] += 1
     if _test_counter[0] % _TESTS_PER_CACHE_EPOCH == 0:
         _clear_all_jit_caches()
+
+
+# ---------------------------------------------------------------------------
+# Fast/slow test lanes (VERDICT r2 item 8: the full suite outgrew a judge
+# session — 26 min at 179 tests on this 1-core box, jax-CPU compiles
+# dominating).  The default profile (pytest.ini: -m "not slow") runs the
+# functional surface; the heavyweight quality/mesh/e2e tests (>~13 s each,
+# ~60% of total wall) carry the `slow` marker and run via
+# `python -m pytest tests/ -m slow` (or `-m ""` for everything).
+# Names listed here instead of per-file marks so the lane assignment lives
+# in ONE reviewable place next to the measured durations that justify it.
+# ---------------------------------------------------------------------------
+_SLOW_TESTS = {
+    "test_bagging_and_feature_fraction_run",
+    "test_beats_linear_model",
+    "test_binary_objective_auc",
+    "test_bundled_training_matches_unbundled_quality",
+    "test_categorical_split_contrib",
+    "test_cli_module_invocation",
+    "test_close_to_sklearn_hist_gbdt",
+    "test_dart_multiclass",
+    "test_dart_quality_comparable_to_gbdt",
+    "test_dart_trains_and_fits",
+    "test_dart_with_valid_set_early_stopping",
+    "test_dp_lambdarank_matches_serial",
+    "test_dp_multiclass_matches_serial",
+    "test_dryrun_multichip_entrypoint",
+    "test_extra_trees_learns_and_differs",
+    "test_frontier_grower_supports_categoricals",
+    "test_frontier_policy_end_to_end_quality",
+    "test_fused_cv_batch_multiple_configs",
+    "test_fused_cv_categorical_matches_host_loop",
+    "test_fused_cv_close_to_host_cv",
+    "test_gamma_objective",
+    "test_interaction_constraints_respected",
+    "test_l1_leaf_renewal_beats_plain_surrogate",
+    "test_lambdarank_beats_pointwise",
+    "test_lambdarank_cv_group_aware",
+    "test_mape_objective",
+    "test_max_delta_step_caps_leaf_values",
+    "test_monotone_constraints_frontier_and_strict",
+    "test_monotone_constraints_hold",
+    "test_monotone_string_form_and_validation",
+    "test_monotone_unconstrained_model_violates",
+    "test_monotone_with_goss_and_dp_mesh",
+    "test_quantile_init_score_and_renewal",
+    "test_subset_splits_beat_threshold_splits",
+    "test_train_api_tree_learner_data_matches_serial",
+    "test_train_api_tree_learner_data_with_bagging",
+    "test_train_api_tree_learner_data_with_categorical",
+    "test_train_api_tree_learner_data_with_goss",
+    "test_train_api_tree_learner_feature_matches_serial",
+    "test_tweedie_objective",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.name.split("[")[0] in _SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)

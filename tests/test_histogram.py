@@ -86,3 +86,37 @@ def test_fused_pallas_feature_blocking(rng):
                             jnp.asarray(seg), K, B, hist_dtype="f32")
     want = _numpy_hist(bins, stats, seg, K, B)
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-3, atol=1e-3)
+
+
+def test_fused_pallas_int8_quantized():
+    """int8 quantized-gradient mode (use_quantized_grad analogue): unbiased
+    stochastic rounding, exact int32 accumulation — histogram within ~1%
+    of exact, count channel near-exact.  Uses its OWN rng: the stochastic
+    tolerance is calibrated to this exact draw (the shared session rng
+    makes the bound order-dependent)."""
+    from lightgbm_tpu.ops.histogram_pallas import hist_fused_pallas
+
+    rng = np.random.default_rng(1234)
+    n, F, B, K = 4000, 4, 32, 5
+    bins = rng.integers(0, B, (n, F)).astype(np.uint8)
+    stats = np.column_stack([
+        rng.normal(0, 1, n), np.abs(rng.normal(0, 1, n)),
+        np.ones(n)]).astype(np.float32)
+    seg = rng.integers(0, K, n).astype(np.int32)
+    got = np.asarray(hist_fused_pallas(
+        jnp.asarray(bins), jnp.asarray(stats), jnp.asarray(seg), K, B,
+        hist_dtype="int8"))
+    want = _numpy_hist(bins, stats, seg, K, B)
+    scale = np.abs(stats).max(axis=0) / 127.0
+    # per-cell error bound: each row contributes <= scale/... stochastic
+    # rounding error < 1 quantum per row; cells hold ~n/(K*B) rows
+    tol = scale * 4 * np.sqrt(n / (K * B) + 9)
+    err = np.abs(got - want).max(axis=(0, 1, 2))
+    assert np.all(err < tol), (err, tol)
+    # totals per (segment, channel): each row's rounding error repeats in
+    # ALL F feature histograms, so the f-summed error has sigma
+    # F * sqrt(rows_per_seg / 12) quanta; allow 4 sigma
+    tg, wg = got.sum(axis=(1, 2)), want.sum(axis=(1, 2))
+    sigma_q = F * np.sqrt(n / K / 12.0)
+    np.testing.assert_allclose(tg, wg, rtol=5e-3,
+                               atol=float(scale.max()) * 4 * sigma_q)
