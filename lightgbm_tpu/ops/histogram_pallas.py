@@ -198,19 +198,18 @@ def _fused_kernel(bins_ref, stats_ref, seg_ref, out_ref, *,
             preferred_element_type=jnp.float32)
         return jnp.where(seg_match, spread, 0.0).astype(out_t)
 
+    # ONE folded operand and ONE dot per feature — the kernel is the same
+    # program for every mode ("f32" is realized as two whole-kernel passes
+    # over a hi/lo split of the stats, summed by the caller: a two-dot
+    # kernel body variant crashed the TPU runtime intermittently)
     if hist_dtype == "int8":
         # stats arrive PRE-QUANTIZED to integers in [-127, 127] (stored as
         # f32, exactly representable) — the dot runs at the MXU's
         # double-rate int8 path with EXACT int32 accumulation
-        operands = (fold(stats, jnp.int8),)
+        operand = fold(stats, jnp.int8)
         oh_t, acc_t = jnp.int8, jnp.int32
-    elif hist_dtype == "bf16":
-        operands = (fold(stats, jnp.bfloat16),)
-        oh_t, acc_t = jnp.bfloat16, jnp.float32
-    else:  # "f32": exact-to-~16-bit hi/lo split, two native-rate passes
-        hi = stats.astype(jnp.bfloat16).astype(jnp.float32)
-        lo = stats - hi
-        operands = (fold(hi, jnp.bfloat16), fold(lo, jnp.bfloat16))
+    else:
+        operand = fold(stats, jnp.bfloat16)
         oh_t, acc_t = jnp.bfloat16, jnp.float32
 
     iota_bt = lax.broadcasted_iota(jnp.int32, (num_bins, chunk), 0)
@@ -222,14 +221,9 @@ def _fused_kernel(bins_ref, stats_ref, seg_ref, out_ref, *,
         codes_t = bins_ref[pl.dslice(f, 1), :]             # [1, chunk] i32
         onehot_t = (iota_bt == codes_t).astype(oh_t)
         tile = lax.dot_general(
-            onehot_t, operands[0],
+            onehot_t, operand,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=acc_t)
-        if len(operands) == 2:
-            tile = tile + lax.dot_general(
-                onehot_t, operands[1],
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=acc_t)
         out_ref[pl.dslice(f, 1), :, :] += tile[None]
         return _
 
@@ -323,27 +317,37 @@ def hist_fused_pallas(
         stats = jnp.clip(jnp.floor(stats / scales[None, :] + r[:, None]),
                          -127.0, 127.0)
 
-    out = pl.pallas_call(
-        functools.partial(_fused_kernel, num_features=num_features,
-                          num_bins=num_bins, num_segments=num_segments,
-                          hist_dtype=hist_dtype),
-        grid=(n_fblk, n_chunks),
-        in_specs=[
-            pl.BlockSpec((f_blk, chunk), lambda fb, c: (fb, c),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((chunk, s), lambda fb, c: (c, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((chunk, 1), lambda fb, c: (c, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((f_blk, num_bins, k),
-                               lambda fb, c: (fb, 0, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(
-            (n_fblk * f_blk, num_bins, k),
-            jnp.int32 if hist_dtype == "int8" else jnp.float32),
-        interpret=interpret,
-    )(bins_t, stats, seg_id.reshape(-1, 1))
+    def one_pass(stats_arr, mode):
+        return pl.pallas_call(
+            functools.partial(_fused_kernel, num_features=num_features,
+                              num_bins=num_bins, num_segments=num_segments,
+                              hist_dtype=mode),
+            grid=(n_fblk, n_chunks),
+            in_specs=[
+                pl.BlockSpec((f_blk, chunk), lambda fb, c: (fb, c),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((chunk, s), lambda fb, c: (c, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((chunk, 1), lambda fb, c: (c, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((f_blk, num_bins, k),
+                                   lambda fb, c: (fb, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct(
+                (n_fblk * f_blk, num_bins, k),
+                jnp.int32 if mode == "int8" else jnp.float32),
+            interpret=interpret,
+        )(bins_t, stats_arr, seg_id.reshape(-1, 1))
+
+    if hist_dtype == "f32":
+        # exact-to-~16-bit hi/lo bf16 split realized as TWO whole-kernel
+        # passes over the identical single-dot program (a two-dot kernel
+        # body crashed the TPU runtime intermittently)
+        hi = stats.astype(jnp.bfloat16).astype(jnp.float32)
+        out = one_pass(hi, "bf16") + one_pass(stats - hi, "bf16")
+    else:
+        out = one_pass(stats, hist_dtype)
     out = out[:num_features]
     out = out.reshape(num_features, num_bins, num_segments, s)
     if scales is not None:
