@@ -162,20 +162,14 @@ def bench_higgs(n=1_000_000, n_rounds=100, num_leaves=127, oracle=True):
 
     from sklearn.metrics import roc_auc_score
 
-    # AUC parity runs the QUALITY config (f32 histograms + near-strict
-    # "half" wave tail, ~2.2x the fast config's device time) — the speed
-    # lines above use the fast default (bf16 + greedy tail), whose own AUC
-    # is also reported.  At 200k validation rows the AUC standard error is
-    # ~7e-4, so gaps are read against a 1M-row validation set (se ~3e-4).
-    b2 = lgb.Booster({**params, "hist_dtype": "f32", "wave_tail": "half"},
-                     ds)
-    b2.update_many(n_rounds)
-    auc_tpu = float(roc_auc_score(yv, b2.predict(Xv,
-                                                 num_iteration=n_rounds)))
+    # AUC of the fast default config (the same one the speed lines use);
+    # the parity-config AUC (near-strict "half" tail) is measured LAST in
+    # main() — that config intermittently crashes the remote TPU worker
+    # (PERF.md "Known issue"), and a crash must not cost the other metrics.
     b3 = lgb.Booster(params, ds)
     b3.update_many(n_rounds)
-    auc_fast = float(roc_auc_score(yv, b3.predict(Xv,
-                                                  num_iteration=n_rounds)))
+    auc_tpu = float(roc_auc_score(yv, b3.predict(Xv,
+                                                 num_iteration=n_rounds)))
 
     out = {
         "rows": n,
@@ -186,7 +180,6 @@ def bench_higgs(n=1_000_000, n_rounds=100, num_leaves=127, oracle=True):
         "hist_mfu": round(mfu, 3),
         "wall_rows_per_s": round(wall_rows_per_s, 1),
         "auc_tpu": round(auc_tpu, 5),
-        "auc_tpu_fast_config": round(auc_fast, 5),
     }
 
     if oracle:
@@ -369,6 +362,30 @@ def bench_criteo_efb(n=200_000, n_sparse=400, n_dense=13, n_rounds=30):
     return out
 
 
+def bench_higgs_parity_auc(n=1_000_000, n_rounds=100, num_leaves=127):
+    """AUC of the QUALITY config (bf16 histograms + near-strict "half"
+    wave tail, ~1.6x the fast config's device time) on the 1M-row
+    validation set.  Run LAST: this config intermittently crashes the
+    remote TPU worker (PERF.md "Known issue — f32/half instability"), and
+    a crash here must not cost the rest of the bench."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils.datasets import make_higgs_like
+    from sklearn.metrics import roc_auc_score
+
+    X, y = make_higgs_like(n)
+    Xv, yv = make_higgs_like(1_000_000, seed=9)
+    params = {"objective": "binary", "num_leaves": num_leaves,
+              "learning_rate": 0.1, "verbosity": -1, "min_data_in_leaf": 20,
+              "hist_dtype": "bf16", "wave_tail": "half",
+              "fused_segment_rounds": 5}
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    b = lgb.Booster(params, ds)
+    b.update_many(n_rounds)
+    return {"higgs_auc_parity_config": round(float(
+        roc_auc_score(yv, b.predict(Xv, num_iteration=n_rounds))), 5)}
+
+
 def main() -> None:
     import sys
 
@@ -387,23 +404,45 @@ def main() -> None:
 
     quick = "--quick" in sys.argv
 
-    row_rounds_per_s, baseline, rmse = bench_diamonds()
     out = {
         "metric": "diamonds_train_row_rounds_per_s",
-        "value": round(row_rounds_per_s, 1),
+        "value": 0.0,
         "unit": "row*rounds/s (200 rounds, 45.9k rows, num_leaves=31)",
-        "vs_baseline": round(row_rounds_per_s / baseline, 3),
-        "diamonds_test_rmse": round(rmse, 5),
+        "vs_baseline": 0.0,
         "terminal_dispatch_ms": _dispatch_latency_ms(),
     }
-    h1 = bench_higgs(1_000_000, n_rounds=100)
-    out.update({f"higgs_{k}": v for k, v in h1.items()})
+
+    def section(label, fn):
+        """One guarded workload: a remote-worker fault (PERF.md known
+        issue) must cost one section, not the whole artifact.  NOTE: after
+        an UNAVAILABLE worker crash, later device sections will fail too —
+        the error strings make that legible in the recorded JSON."""
+        try:
+            out.update(fn())
+        except Exception as e:  # noqa: BLE001 — artifact over purity
+            out[f"{label}_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    def diamonds():
+        row_rounds_per_s, baseline, rmse = bench_diamonds()
+        return {
+            "value": round(row_rounds_per_s, 1),
+            "vs_baseline": round(row_rounds_per_s / baseline, 3),
+            "diamonds_test_rmse": round(rmse, 5),
+        }
+
+    section("diamonds", diamonds)
+    section("higgs", lambda: {
+        f"higgs_{k}": v for k, v in
+        bench_higgs(1_000_000, n_rounds=100).items()})
     if not quick:
-        h11 = bench_higgs(11_000_000, n_rounds=30)
-        out.update({f"higgs11m_{k}": v for k, v in h11.items()})
-    out.update(bench_sweep(12 if quick else 108))
-    out.update(bench_mslr())
-    out.update(bench_criteo_efb())
+        section("higgs11m", lambda: {
+            f"higgs11m_{k}": v for k, v in
+            bench_higgs(11_000_000, n_rounds=30).items()})
+    section("sweep", lambda: bench_sweep(12 if quick else 108))
+    section("mslr", bench_mslr)
+    section("criteo_efb", bench_criteo_efb)
+    # crash-prone parity config LAST (see bench_higgs_parity_auc docstring)
+    section("higgs_parity", bench_higgs_parity_auc)
     print(json.dumps(out))
 
 

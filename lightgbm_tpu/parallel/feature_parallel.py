@@ -99,3 +99,70 @@ def make_fp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
         check_vma=False,  # tree replicated by construction via all_gather
     )
     return jax.jit(sharded)
+
+
+def make_mesh_2d(n_data: int, n_feature: int, devices=None) -> Mesh:
+    """2-D (rows x features) mesh: Mesh([n_data, n_feature],
+    ('data', 'feature')) — the composition of the dp and fp learners
+    (SURVEY.md §2C parallelism rows; upstream has no direct analogue —
+    its tree_learner options are mutually exclusive)."""
+    from .data_parallel import DATA_AXIS
+
+    if devices is None:
+        devices = jax.devices()
+        if len(devices) < n_data * n_feature:
+            try:
+                cpus = jax.devices("cpu")
+            except RuntimeError:
+                cpus = []
+            if len(cpus) >= n_data * n_feature:
+                devices = cpus
+    need = n_data * n_feature
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(n_data, n_feature)
+    return Mesh(arr, (DATA_AXIS, FEATURE_AXIS))
+
+
+@functools.lru_cache(maxsize=None)
+def make_dp_fp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
+                          num_bins: int, hist_impl: str = "auto",
+                          row_chunk: int = 131072, is_rf: bool = False,
+                          hist_dtype: str = "f32"):
+    """2-D composed round step: each device holds an [n/dr, F/dc] block;
+    per-block histograms psum-merge over the DATA axis (the dp allreduce),
+    per-column-slice best splits exchange over the FEATURE axis (the fp
+    allgather + argmax), and the winning split column broadcasts with one
+    psum — both collectives ride the same mesh.
+
+    step(bins_2dsharded, y, w, bag, pred [all row-sharded],
+    fmask_fsharded, hyper, key) -> (tree [replicated],
+    new_pred [row-sharded]).
+    """
+    from .data_parallel import DATA_AXIS
+
+    obj = _rebuild_objective(obj_key)
+
+    def step(bins_b, y_l, w_l, bag_l, pred_l, fmask_l, hyper: HyperScalars,
+             key):
+        g, h = obj.grad_hess(pred_l, y_l, w_l)
+        stats = jnp.stack([g * bag_l, h * bag_l,
+                           (bag_l > 0).astype(jnp.float32)], axis=-1)
+        tree, row_leaf = grow_tree(
+            bins_b, stats, fmask_l, hyper.ctx(), num_leaves, num_bins,
+            hyper.max_depth, key=key, axis_name=DATA_AXIS,
+            fp_axis=FEATURE_AXIS, hist_impl=hist_impl, row_chunk=row_chunk,
+            hist_dtype=hist_dtype, wave_width=1)
+        shrink = jnp.where(is_rf, 1.0, hyper.learning_rate)
+        new_pred = pred_l + shrink * lookup_values(row_leaf, tree.leaf_value)
+        return tree, new_pred
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P("data", FEATURE_AXIS), P("data"), P("data"), P("data"),
+                  P("data"), P(FEATURE_AXIS), P(), P()),
+        out_specs=(P(), P("data")),
+        check_vma=False,  # tree replicated via psum + all_gather
+    )
+    return jax.jit(sharded)

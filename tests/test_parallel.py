@@ -327,3 +327,70 @@ def test_train_api_tree_learner_data_with_categorical():
     rmse_d = float(np.sqrt(np.mean((pd - y) ** 2)))
     assert abs(rmse_s - rmse_d) < 0.02 * rmse_s, (rmse_s, rmse_d)
     assert float(np.mean(np.abs(ps - pd))) < 0.05
+
+
+def test_2d_mesh_dp_fp_composition_matches_serial():
+    """Stretch (VERDICT r2 item 9): rows x features 2-D mesh — histograms
+    psum over 'data', split exchange over 'feature' — must reproduce the
+    serial strict grower's model."""
+    import jax
+    import jax.numpy as jnp
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.models.gbdt import (HyperScalars,
+                                          _objective_static_key)
+    from lightgbm_tpu.config import parse_params
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.parallel.feature_parallel import (
+        make_dp_fp_train_step, make_mesh_2d, pad_features)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rng = np.random.default_rng(11)
+    n, f = 2048, 6
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] * 2 + np.sin(X[:, 1] * 3) + X[:, 2] * X[:, 3]
+         + rng.normal(0, 0.1, n)).astype(np.float32)
+    params = {"objective": "regression", "num_leaves": 15,
+              "learning_rate": 0.2, "verbosity": -1,
+              "grow_policy": "leafwise"}
+
+    serial = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=5)
+
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    p = parse_params(params)
+    obj = create_objective(p)
+    mesh = make_mesh_2d(4, 2)
+    codes = pad_features(np.asarray(ds.X_binned), 2)
+    fmask = np.zeros(codes.shape[1], np.float32)
+    fmask[:f] = 1.0
+
+    step = make_dp_fp_train_step(
+        mesh, _objective_static_key(obj, p), p.num_leaves, ds.num_bins)
+    bins_b = jax.device_put(jnp.asarray(codes),
+                            NamedSharding(mesh, P("data", "feature")))
+    fmask_d = jax.device_put(jnp.asarray(fmask),
+                             NamedSharding(mesh, P("feature")))
+    row = NamedSharding(mesh, P("data"))
+    yd = jax.device_put(ds.y, row)
+    wd = jax.device_put(ds.w, row)
+    bag = jax.device_put(ds.row_mask, row)
+    init = float(obj.init_score(np.asarray(ds.get_label()),
+                                np.ones(ds.num_data())))
+    pred = jax.device_put(jnp.full(ds.row_mask.shape, init, jnp.float32),
+                          row)
+    hyper = HyperScalars.from_params(p)
+    trees = []
+    for r in range(5):
+        key = jax.random.fold_in(jax.random.PRNGKey(p.seed), r)
+        tree, pred = step(bins_b, yd, wd, bag, pred, fmask_d, hyper, key)
+        trees.append(tree)
+
+    for ts, td in zip(serial.trees, trees):
+        np.testing.assert_array_equal(np.asarray(ts.split_feature),
+                                      np.asarray(td.split_feature))
+        np.testing.assert_array_equal(np.asarray(ts.split_bin),
+                                      np.asarray(td.split_bin))
+        np.testing.assert_allclose(np.asarray(ts.leaf_value),
+                                   np.asarray(td.leaf_value),
+                                   rtol=2e-4, atol=2e-4)
