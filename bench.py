@@ -66,7 +66,11 @@ def _in_subprocess(fn_expr: str, timeout: int, retries: int = 1):
             for line in reversed(r.stdout.splitlines()):
                 if line.startswith("@@RESULT@@"):
                     return json.loads(line[len("@@RESULT@@"):])
-            err = (r.stderr.strip().splitlines() or ["empty stderr"])[-1][-200:]
+            # surface the actual exception line, not traceback boilerplate
+            err_lines = [ln for ln in r.stderr.splitlines()
+                         if "Error" in ln and "For simplicity" not in ln]
+            err = (err_lines or r.stderr.strip().splitlines()
+                   or ["empty stderr"])[-1][-220:]
         except subprocess.TimeoutExpired:
             err = f"timeout after {timeout}s"
         if attempt < retries:
@@ -195,17 +199,6 @@ def bench_higgs(n=1_000_000, n_rounds=100, num_leaves=127, oracle=True):
         wall = min(wall, time.perf_counter() - t0)
     wall_rows_per_s = n * 30 / wall
 
-    from sklearn.metrics import roc_auc_score
-
-    # AUC of the fast default config (the same one the speed lines use);
-    # the parity-config AUC (near-strict "half" tail) is measured LAST in
-    # main() — that config intermittently crashes the remote TPU worker
-    # (PERF.md "Known issue"), and a crash must not cost the other metrics.
-    b3 = lgb.Booster(params, ds)
-    b3.update_many(n_rounds)
-    auc_tpu = float(roc_auc_score(yv, b3.predict(Xv,
-                                                 num_iteration=n_rounds)))
-
     out = {
         "rows": n,
         "rounds": n_rounds,
@@ -214,29 +207,45 @@ def bench_higgs(n=1_000_000, n_rounds=100, num_leaves=127, oracle=True):
         "device_rows_per_s": round(dev_rows_per_s, 1),
         "hist_mfu": round(mfu, 3),
         "wall_rows_per_s": round(wall_rows_per_s, 1),
-        "auc_tpu": round(auc_tpu, 5),
     }
-
-    if oracle:
-        from sklearn.ensemble import HistGradientBoostingClassifier
-
-        orc = HistGradientBoostingClassifier(
-            max_iter=n_rounds, max_leaf_nodes=num_leaves, learning_rate=0.1,
-            min_samples_leaf=20, max_bins=255, early_stopping=False,
-            validation_fraction=None)
-        t0 = time.perf_counter()
-        orc.fit(X, y)
-        cpu_s = time.perf_counter() - t0
-        cpu_rows_per_s = n * n_rounds / cpu_s
-        auc_cpu = float(roc_auc_score(yv, orc.predict_proba(Xv)[:, 1]))
-        out.update({
-            "cpu_oracle_rows_per_s": round(cpu_rows_per_s, 1),
-            "vs_oracle_device": round(dev_rows_per_s / cpu_rows_per_s, 3),
-            "vs_oracle_wall": round(wall_rows_per_s / cpu_rows_per_s, 3),
-            "auc_cpu_oracle": round(auc_cpu, 5),
-            "auc_gap": round(auc_cpu - auc_tpu, 5),
-        })
     return out
+
+
+def higgs_quality_section(n, n_rounds, prefix="higgs", num_leaves=127):
+    """TPU AUC (fast default config) + the CPU oracle's throughput and
+    AUC — separate from the speed section so a worker crash costs one of
+    the two, not both."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils.datasets import make_higgs_like
+    from sklearn.ensemble import HistGradientBoostingClassifier
+    from sklearn.metrics import roc_auc_score
+
+    X, y = make_higgs_like(n)
+    Xv, yv = make_higgs_like(1_000_000, seed=9)
+    params = {"objective": "binary", "num_leaves": num_leaves,
+              "learning_rate": 0.1, "verbosity": -1, "min_data_in_leaf": 20,
+              "fused_segment_rounds": 10}
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    b = lgb.Booster(params, ds)
+    b.update_many(n_rounds)
+    auc_tpu = float(roc_auc_score(
+        yv, b.predict(Xv, num_iteration=n_rounds)))
+
+    orc = HistGradientBoostingClassifier(
+        max_iter=n_rounds, max_leaf_nodes=num_leaves, learning_rate=0.1,
+        min_samples_leaf=20, max_bins=255, early_stopping=False,
+        validation_fraction=None)
+    t0 = time.perf_counter()
+    orc.fit(X, y)
+    cpu_s = time.perf_counter() - t0
+    auc_cpu = float(roc_auc_score(yv, orc.predict_proba(Xv)[:, 1]))
+    return {
+        f"{prefix}_auc_tpu": round(auc_tpu, 5),
+        f"{prefix}_cpu_oracle_rows_per_s": round(n * n_rounds / cpu_s, 1),
+        f"{prefix}_auc_cpu_oracle": round(auc_cpu, 5),
+        f"{prefix}_auc_gap": round(auc_cpu - auc_tpu, 5),
+    }
 
 
 def bench_sweep(n_configs=108, nfold=5, num_boost_round=1000):
@@ -447,23 +456,38 @@ def main() -> None:
         "terminal_dispatch_ms": _dispatch_latency_ms(),
     }
 
-    def section(label, fn_expr, timeout):
+    def section(label, fn_expr, timeout, retries=1):
         """One crash-isolated workload subprocess: a remote-worker fault
         (PERF.md known issue) costs one section, not the artifact."""
         try:
-            out.update(_in_subprocess(fn_expr, timeout))
+            out.update(_in_subprocess(fn_expr, timeout, retries))
         except Exception as e:  # noqa: BLE001 — artifact over purity
-            out[f"{label}_error"] = f"{type(e).__name__}: {e}"[:200]
+            out[f"{label}_error"] = f"{type(e).__name__}: {e}"[:220]
 
+    # Higgs split into speed / AUC / oracle sub-sections: the remote
+    # worker's crash probability grows with per-process device work, so
+    # smaller sections maximize the recorded artifact
     section("diamonds", "diamonds_section()", 1200)
-    section("higgs", "higgs_section(1_000_000, 100)", 2400)
+    section("higgs", "higgs_section(1_000_000, 100, 'higgs', False)", 1800,
+            retries=2)
+    section("higgs_quality",
+            "higgs_quality_section(1_000_000, 100)", 1800, retries=2)
     if not quick:
-        section("higgs11m", "higgs_section(11_000_000, 30, 'higgs11m')",
-                3000)
+        section("higgs11m",
+                "higgs_section(11_000_000, 30, 'higgs11m', False)", 2400,
+                retries=2)
+        section("higgs11m_quality",
+                "higgs_quality_section(11_000_000, 30, 'higgs11m')", 2400)
     section("sweep", f"bench_sweep({12 if quick else 108})", 3600)
     section("mslr", "bench_mslr()", 1500)
     section("criteo_efb", "bench_criteo_efb()", 1500)
     section("higgs_parity", "bench_higgs_parity_auc()", 1800)
+    # stitch cross-section ratios where both halves made it
+    for prefix in ("higgs", "higgs11m"):
+        dev = out.get(f"{prefix}_device_rows_per_s")
+        orc = out.get(f"{prefix}_cpu_oracle_rows_per_s")
+        if dev and orc:
+            out[f"{prefix}_vs_oracle_device"] = round(dev / orc, 3)
     print(json.dumps(out))
 
 
@@ -476,9 +500,10 @@ def diamonds_section():
     }
 
 
-def higgs_section(n, n_rounds, prefix="higgs"):
+def higgs_section(n, n_rounds, prefix="higgs", oracle=False):
     return {f"{prefix}_{k}": v
-            for k, v in bench_higgs(n, n_rounds=n_rounds).items()}
+            for k, v in bench_higgs(n, n_rounds=n_rounds,
+                                    oracle=oracle).items()}
 
 
 if __name__ == "__main__":
