@@ -241,6 +241,7 @@ def higgs_quality_section(n, n_rounds, prefix="higgs", num_leaves=127):
     cpu_s = time.perf_counter() - t0
     auc_cpu = float(roc_auc_score(yv, orc.predict_proba(Xv)[:, 1]))
     return {
+        f"{prefix}_quality_rounds": n_rounds,
         f"{prefix}_auc_tpu": round(auc_tpu, 5),
         f"{prefix}_cpu_oracle_rows_per_s": round(n * n_rounds / cpu_s, 1),
         f"{prefix}_auc_cpu_oracle": round(auc_cpu, 5),
@@ -458,11 +459,20 @@ def main() -> None:
 
     def section(label, fn_expr, timeout, retries=1):
         """One crash-isolated workload subprocess: a remote-worker fault
-        (PERF.md known issue) costs one section, not the artifact."""
-        try:
-            out.update(_in_subprocess(fn_expr, timeout, retries))
-        except Exception as e:  # noqa: BLE001 — artifact over purity
-            out[f"{label}_error"] = f"{type(e).__name__}: {e}"[:220]
+        (PERF.md known issue) costs one section, not the artifact.
+        ``fn_expr`` may be a LIST of fallback expressions — the degraded
+        worker sometimes survives only smaller round budgets, and a
+        reduced measurement beats a missing one (the recorded keys state
+        what actually ran)."""
+        exprs = fn_expr if isinstance(fn_expr, list) else [fn_expr]
+        err = None
+        for expr in exprs:
+            try:
+                out.update(_in_subprocess(expr, timeout, retries))
+                return
+            except Exception as e:  # noqa: BLE001 — artifact over purity
+                err = e
+        out[f"{label}_error"] = f"{type(err).__name__}: {err}"[:220]
 
     # Higgs split into speed / AUC / oracle sub-sections: the remote
     # worker's crash probability grows with per-process device work, so
@@ -471,17 +481,21 @@ def main() -> None:
     section("higgs", "higgs_section(1_000_000, 100, 'higgs', False)", 1800,
             retries=2)
     section("higgs_quality",
-            "higgs_quality_section(1_000_000, 100)", 1800, retries=2)
+            ["higgs_quality_section(1_000_000, 100)",
+             "higgs_quality_section(1_000_000, 40)"], 1800)
     if not quick:
         section("higgs11m",
                 "higgs_section(11_000_000, 30, 'higgs11m', False)", 2400,
                 retries=2)
         section("higgs11m_quality",
-                "higgs_quality_section(11_000_000, 30, 'higgs11m')", 2400)
+                ["higgs_quality_section(11_000_000, 30, 'higgs11m')",
+                 "higgs_quality_section(11_000_000, 10, 'higgs11m')"],
+                2400)
     section("sweep", f"bench_sweep({12 if quick else 108})", 3600)
     section("mslr", "bench_mslr()", 1500)
     section("criteo_efb", "bench_criteo_efb()", 1500)
-    section("higgs_parity", "bench_higgs_parity_auc()", 1800)
+    section("higgs_parity", ["bench_higgs_parity_auc()",
+                             "bench_higgs_parity_auc(1_000_000, 40)"], 1800)
     # stitch cross-section ratios where both halves made it
     for prefix in ("higgs", "higgs11m"):
         dev = out.get(f"{prefix}_device_rows_per_s")
