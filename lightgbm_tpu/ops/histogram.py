@@ -130,7 +130,14 @@ def compute_histograms(
     Returns:
       f32 ``[num_segments, F, num_bins, S]``.
     """
-    if impl == "pallas" or (impl == "auto"
+    # "f32x" = EXPLICIT f32 request (resolve_hist_dtype): a contract for
+    # exactness, so auto-routing may not swap in the fused kernel's hi/lo
+    # bf16 approximation (~1e-5 relative) — only a forced hist_impl=
+    # "pallas" overrides it (ADVICE r3)
+    exact = hist_dtype == "f32x"
+    if exact:
+        hist_dtype = "f32"
+    if impl == "pallas" or (impl == "auto" and not exact
                             and jax.default_backend() == "tpu"):
         # the fused kernel folds the segment one-hot in VMEM and keeps the
         # [F, B, K] accumulator resident — ~100x less HBM traffic than the
@@ -177,7 +184,10 @@ def compute_histograms_batched(
     k_inner = e * num_segments * s
     segstats = _segstats(stats, seg_id, num_segments)      # [E, n, K*S]
     segstats = jnp.moveaxis(segstats, 0, 1).reshape(n, k_inner)
-    if impl == "pallas" or (impl == "auto" and k_inner >= 64
+    exact = hist_dtype == "f32x"          # see compute_histograms
+    if exact:
+        hist_dtype = "f32"
+    if impl == "pallas" or (impl == "auto" and not exact and k_inner >= 64
                             and jax.default_backend() == "tpu"):
         from .histogram_pallas import hist_from_segstats_pallas
         hists = hist_from_segstats_pallas(bins, segstats, num_bins,

@@ -246,6 +246,16 @@ def hist_fused_pallas(
     n, num_features = bins.shape
     s = stats.shape[1]
     k = num_segments * s
+    if hist_dtype == "f32x":     # explicit-f32 token (resolve_hist_dtype);
+        hist_dtype = "f32"       # forced-pallas callers get the hi/lo split
+    if hist_dtype == "int8" and n > 16_000_000:
+        # int32 accumulation wraps past 2^31/127 ~= 16.9M rows landing in
+        # one (segment, bin) cell — beyond that, corrupt histograms would
+        # be silent (ADVICE r3).  Shard rows (dp mesh) or use bf16.
+        raise ValueError(
+            f"hist_dtype='int8' is limited to 16M rows per device shard "
+            f"(got n={n}): the int32 bin accumulator can overflow. "
+            f"Use hist_dtype='bf16' or shard rows across devices.")
     # VMEM (16 MB scoped limit on v5e): the [F_blk, B, K] f32 accumulator
     # stays resident; when the full feature axis does not fit (MSLR's 136
     # features x 128 lanes ~= 18 MB), features split into grid-major blocks

@@ -132,6 +132,12 @@ def profile_training(params: Dict[str, Any], X, y,
     report["rows"] = ds.num_data_
     report["rows_per_s"] = ds.num_data_ * num_boost_round / \
         report["train_total_s"]
-    report["hist_dtype"] = hd
-    report["wave_width"] = ww
+    # "f32x" is the internal explicit-f32 routing token — report the
+    # user-facing name
+    report["hist_dtype"] = "f32" if hd == "f32x" else hd
+    # the tail policy rides in the SIGN of the static width (models/gbdt
+    # resolve_wave_width) — surface it as a named field, not a negative
+    # width (ADVICE r3)
+    report["wave_width"] = abs(ww)
+    report["wave_tail"] = "greedy" if ww < 0 else "half"
     return report

@@ -221,26 +221,38 @@ def _run_fused(grid, parsed, train_set, ledger, num_boost_round, nfold,
         # and stopping round is dominated by lr (mixing lr=0.1 with lr=0.01
         # makes the fast configs idle-run ~5x their needed rounds).
         key = (p.num_leaves, p.bagging_freq if p.bagging_fraction < 1 else 0,
-               p.objective, train_set.num_bins, p.alpha, p.sigmoid,
-               p.scale_pos_weight, p.is_unbalance, p.fair_c,
+               p.objective, p.num_class, train_set.num_bins, p.alpha,
+               p.sigmoid, p.scale_pos_weight, p.is_unbalance, p.fair_c,
                p.poisson_max_delta_step, p.learning_rate)
         buckets.setdefault(key, []).append(i)
 
+    stats = {"buckets": [], "compile_s": 0.0, "exec_s": 0.0,
+             "rounds_total": 0}
     for key, idxs in sorted(buckets.items()):
         if verbose:
             print(f"fused bucket num_leaves={key[0]} bagging_freq={key[1]}: "
                   f"{len(idxs)} configs x {nfold} folds")
         t0 = time.time()
+        timings: Dict[str, float] = {}
         hist, best_iters, best_raw, rounds, metric_name = run_fused_cv_batch(
             train_set, [parsed[i] for i in idxs], fold_masks,
-            num_boost_round, early_stopping_rounds, seed)
+            num_boost_round, early_stopping_rounds, seed, timings=timings)
         hib = get_metric(metric_name).higher_better
         for j, i in enumerate(idxs):
             raw = float(best_raw[j])
             ledger.rows[i]["iteration"] = int(best_iters[j])
             ledger.rows[i]["score"] = raw if hib else -raw
         ledger.save()
+        el = time.time() - t0
+        stats["buckets"].append(
+            {"num_leaves": key[0], "configs": len(idxs), "s": round(el, 2),
+             "rounds": rounds, **{k: round(v, 2)
+                                  for k, v in timings.items()}})
+        stats["compile_s"] += timings.get("compile_s", 0.0)
+        stats["exec_s"] += timings.get("exec_s", 0.0)
+        stats["rounds_total"] += rounds
         if verbose:
-            print(f"  bucket done in {time.time() - t0:.1f}s "
-                  f"({rounds} rounds run)")
+            print(f"  bucket done in {el:.1f}s ({rounds} rounds run, "
+                  f"compile {timings.get('compile_s', 0):.1f}s)")
+    ledger.sweep_stats = stats
     return ledger

@@ -1,0 +1,52 @@
+"""Record lane health as an artifact: writes TESTS_r{N}.json.
+
+VERDICT r3 #9: the slow lane (heavyweight quality/mesh/e2e assertions) only
+runs when someone remembers ``-m slow``, and nothing in the repo proved it
+ran green.  This runner executes both lanes and snapshots pass counts +
+wall time next to the bench artifacts, so lane health is visible without
+re-running ~25 minutes of tests.
+
+Usage:  python tools/test_report.py [round_number] [--fast-only]
+"""
+
+import json
+import re
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def run_lane(args, label):
+    t0 = time.time()
+    r = subprocess.run([sys.executable, "-m", "pytest", "tests/", "-q", *args],
+                       capture_output=True, text=True, cwd=REPO)
+    wall = round(time.time() - t0, 1)
+    tail = (r.stdout.strip().splitlines() or [""])[-1]
+    counts = {v: int(k) for k, v in
+              re.findall(r"(\d+) (passed|failed|errors?|deselected)", tail)}
+    return {f"{label}_passed": counts.get("passed", 0),
+            f"{label}_failed": counts.get("failed", 0)
+            + counts.get("error", counts.get("errors", 0)),
+            f"{label}_wall_s": wall,
+            f"{label}_rc": r.returncode,
+            f"{label}_summary": tail[-160:]}
+
+
+def main():
+    rnd = next((a for a in sys.argv[1:] if a.isdigit()), "04")
+    out = {"recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    out.update(run_lane([], "fast"))
+    if "--fast-only" not in sys.argv:
+        out.update(run_lane(["-m", "slow"], "slow"))
+    path = REPO / f"TESTS_r{int(rnd):02d}.json"
+    path.write_text(json.dumps(out, indent=1))
+    print(json.dumps(out))
+    ok = out["fast_rc"] == 0 and out.get("slow_rc", 0) == 0
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
