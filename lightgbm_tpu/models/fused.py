@@ -76,7 +76,8 @@ def _fused_cv_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                  metric_rho: float, t_max: int,
                  bagging_freq: int, n_configs: int, n_folds: int,
                  hist_impl: str, row_chunk: int, hist_dtype: str = "f32",
-                 cat_key: Optional[tuple] = None, num_class: int = 1):
+                 cat_key: Optional[tuple] = None, num_class: int = 1,
+                 wave_width: int = 1):
     """Build the jitted fused-cv program for one static configuration."""
     obj = _rebuild_objective(obj_key)
     metric = get_metric(metric_name,
@@ -106,6 +107,7 @@ def _fused_cv_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                 hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
                 key=kc, hist_impl=hist_impl,
                 row_chunk=row_chunk, hist_dtype=hist_dtype,
+                wave_width=wave_width,
                 cat_info=_build_cat_info(cat_key, num_features))
 
         if num_class > 1:
@@ -192,6 +194,24 @@ def _fused_cv_fn(obj_key: tuple, num_leaves: int, num_bins: int,
         )
 
     return run_segment, init_carry, finalize
+
+
+def _fused_wave_width(p: Params, n_pad: int) -> int:
+    """Wave width for the BATCHED regime: strict growth below ~2^19 rows.
+
+    With the configs x folds batch axis already amortizing per-pass fixed
+    costs, waves' extra FLOPs and per-wave partition work LOSE at small n
+    (measured r4: nl=127 strict 192 ms/round vs waves 368 ms at the
+    46k-row sweep shape; at 1M rows the trade flips, same as the host
+    path).  An EXPLICIT grow_policy or wave_width still wins — cv must
+    grow trees the same way the user's final training will.
+    """
+    explicit = (p.grow_policy != "auto"
+                or int(p.extra.get("wave_width", 0)) != 0)
+    if not explicit and n_pad < (1 << 19):
+        return 1
+    from .gbdt import resolve_wave_width
+    return resolve_wave_width(p, n_pad)
 
 
 def fused_cv_eligible(p: Params, feval, callbacks, train_set=None) -> bool:
@@ -319,7 +339,7 @@ def run_fused_cv_batch(
         num_boost_round, int(bagging_freq),
         n_configs, n_folds, p0.extra.get("hist_impl", "auto"),
         int(p0.extra.get("row_chunk", 131072)),
-        resolve_hist_dtype(p0, n_pad), cat_key, num_class)
+        resolve_hist_dtype(p0, n_pad), cat_key, num_class, _fused_wave_width(p0, n_pad))
 
     tm_d = jnp.asarray(tm)
     carry = init_carry(n_pad, jnp.asarray(init, jnp.float32)

@@ -182,11 +182,25 @@ def compute_histograms_batched(
     e, n, s = stats.shape
     num_features = bins.shape[1]
     k_inner = e * num_segments * s
-    segstats = _segstats(stats, seg_id, num_segments)      # [E, n, K*S]
-    segstats = jnp.moveaxis(segstats, 0, 1).reshape(n, k_inner)
     exact = hist_dtype == "f32x"          # see compute_histograms
     if exact:
         hist_dtype = "f32"
+    if (impl in ("pallas", "auto") and not exact and hist_dtype != "int8"
+            and num_segments * s >= 64
+            and jax.default_backend() == "tpu"):
+        # WIDE-segment batches only (wave grower under vmap, W*S >= 64
+        # lanes): the element axis becomes a kernel GRID dim so per-element
+        # segment folds happen in VMEM, never materializing the
+        # [n, E*K*S] segstats operand in HBM (~700 MB/wave at the sweep
+        # shape).  Narrow-segment calls (strict grower's K=2, root's K=1)
+        # stay on the segstats route: their operand is small, the fold is
+        # cheaper as one XLA pass, and sub-8-lane kernel blocks are the
+        # Mosaic-fragility zone (r4: k=6 blocks faulted the TPU worker).
+        from .histogram_pallas import hist_fused_pallas_batched
+        return hist_fused_pallas_batched(bins, stats, seg_id, num_segments,
+                                         num_bins, hist_dtype=hist_dtype)
+    segstats = _segstats(stats, seg_id, num_segments)      # [E, n, K*S]
+    segstats = jnp.moveaxis(segstats, 0, 1).reshape(n, k_inner)
     if impl == "pallas" or (impl == "auto" and not exact and k_inner >= 64
                             and jax.default_backend() == "tpu"):
         from .histogram_pallas import hist_from_segstats_pallas

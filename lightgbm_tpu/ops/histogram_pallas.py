@@ -171,31 +171,37 @@ def compute_histograms_pallas(
 
 def _fused_kernel(bins_ref, stats_ref, seg_ref, out_ref, *,
                   num_features: int, num_bins: int, num_segments: int,
-                  hist_dtype: str):
-    @pl.when(pl.program_id(1) == 0)
+                  hist_dtype: str, chunk_dim: int = 1):
+    @pl.when(pl.program_id(chunk_dim) == 0)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
     chunk = bins_ref.shape[1]                              # bins [F, chunk]
-    s = stats_ref.shape[1]
+    s = stats_ref.shape[0]
     w = num_segments
-    stats = stats_ref[:]                                   # [chunk, S] f32
-    seg = seg_ref[:]                                       # [chunk, 1] i32
+    # ALL row-axis operands arrive TRANSPOSED ([S, chunk] stats,
+    # [1, chunk] seg): rows must be the 128-lane MINOR dim, because XLA
+    # stages pallas operands into (8, 128)-tiled HBM layouts and a
+    # row-major [n, 1]/[n, 3] operand pads its 1-3 lanes to 128 — a
+    # 42-128x HBM blowup that OOM'd the 11M-row north star (r4: 15.75 GB
+    # chip, 18.4 GB demanded, ~16 GB of it this padding).
+    stats = stats_ref[:]                                   # [S, chunk] f32
+    seg = seg_ref[:]                                       # [1, chunk] i32
     # 2-D-only fold (Mosaic cannot collapse a non-lane-aligned minor dim,
     # and lane-tiling ops like jnp.tile pad each S-lane segment to a full
-    # 128-lane tile — measured 19-43 MB of scoped VMEM): lane k of the
-    # folded tile is stats[:, k % S] masked to seg == k // S, built as a
-    # tiny [S, W*S] selection matmul + a 2-D mask.
-    iota_k = lax.broadcasted_iota(jnp.int32, (chunk, w * s), 1)
-    seg_match = seg == iota_k // s                          # [chunk, W*S]
-    proj = (lax.broadcasted_iota(jnp.int32, (s, w * s), 1) % s
-            == lax.broadcasted_iota(jnp.int32, (s, w * s), 0))
+    # 128-lane tile — measured 19-43 MB of scoped VMEM): row k of the
+    # folded tile is stats[k % S, :] masked to seg == k // S, built as a
+    # tiny [W*S, S] selection matmul + a 2-D mask.
+    iota_r = lax.broadcasted_iota(jnp.int32, (w * s, chunk), 0)
+    seg_match = seg == iota_r // s                          # [W*S, chunk]
+    proj_t = (lax.broadcasted_iota(jnp.int32, (w * s, s), 0) % s
+              == lax.broadcasted_iota(jnp.int32, (w * s, s), 1))
 
     def fold(st, out_t):
         spread = lax.dot_general(
-            st.astype(jnp.float32), proj.astype(jnp.float32),
+            proj_t.astype(jnp.float32), st.astype(jnp.float32),
             dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32)             # [W*S, chunk]
         return jnp.where(seg_match, spread, 0.0).astype(out_t)
 
     # ONE folded operand and ONE dot per feature — the kernel is the same
@@ -222,8 +228,8 @@ def _fused_kernel(bins_ref, stats_ref, seg_ref, out_ref, *,
         onehot_t = (iota_bt == codes_t).astype(oh_t)
         tile = lax.dot_general(
             onehot_t, operand,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=acc_t)
+            dimension_numbers=(((1,), (1,)), ((), ())),     # NT: both on
+            preferred_element_type=acc_t)                   # the chunk dim
         out_ref[pl.dslice(f, 1), :, :] += tile[None]
         return _
 
@@ -327,6 +333,10 @@ def hist_fused_pallas(
         stats = jnp.clip(jnp.floor(stats / scales[None, :] + r[:, None]),
                          -127.0, 127.0)
 
+    # row axis on the 128-lane MINOR dim (see _fused_kernel layout note)
+    stats_t = stats.T                                       # [S, n]
+    seg_row = seg_id.reshape(1, -1)                         # [1, n]
+
     def one_pass(stats_arr, mode):
         return pl.pallas_call(
             functools.partial(_fused_kernel, num_features=num_features,
@@ -336,9 +346,9 @@ def hist_fused_pallas(
             in_specs=[
                 pl.BlockSpec((f_blk, chunk), lambda fb, c: (fb, c),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((chunk, s), lambda fb, c: (c, 0),
+                pl.BlockSpec((s, chunk), lambda fb, c: (0, c),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((chunk, 1), lambda fb, c: (c, 0),
+                pl.BlockSpec((1, chunk), lambda fb, c: (0, c),
                              memory_space=pltpu.VMEM),
             ],
             out_specs=pl.BlockSpec((f_blk, num_bins, k),
@@ -348,18 +358,124 @@ def hist_fused_pallas(
                 (n_fblk * f_blk, num_bins, k),
                 jnp.int32 if mode == "int8" else jnp.float32),
             interpret=interpret,
-        )(bins_t, stats_arr, seg_id.reshape(-1, 1))
+        )(bins_t, stats_arr, seg_row)
 
     if hist_dtype == "f32":
         # exact-to-~16-bit hi/lo bf16 split realized as TWO whole-kernel
         # passes over the identical single-dot program (a two-dot kernel
         # body crashed the TPU runtime intermittently)
-        hi = stats.astype(jnp.bfloat16).astype(jnp.float32)
-        out = one_pass(hi, "bf16") + one_pass(stats - hi, "bf16")
+        hi = stats_t.astype(jnp.bfloat16).astype(jnp.float32)
+        out = one_pass(hi, "bf16") + one_pass(stats_t - hi, "bf16")
     else:
-        out = one_pass(stats, hist_dtype)
+        out = one_pass(stats_t, hist_dtype)
     out = out[:num_features]
     out = out.reshape(num_features, num_bins, num_segments, s)
     if scales is not None:
         out = out.astype(jnp.float32) * scales[None, None, None, :]
     return out.transpose(2, 0, 1, 3)
+
+
+def hist_fused_pallas_batched(
+    bins: jnp.ndarray,           # [n, F] shared bin codes
+    stats: jnp.ndarray,          # [E, n, S] per-element statistics
+    seg_id: jnp.ndarray,         # [E, n] per-element row segments
+    num_segments: int,
+    num_bins: int,
+    chunk: Optional[int] = None,
+    interpret: bool | None = None,
+    hist_dtype: str = "f32",
+) -> jnp.ndarray:
+    """Batched fused histograms: -> f32 [E, num_segments, F, num_bins, S].
+
+    The element axis (configs x folds of the fused cv trainer, classes of
+    multiclass) becomes a GRID dimension over the same single-dot kernel:
+    each (element, feature-block, chunk) step folds that element's
+    segment one-hot with its stats entirely in VMEM and contracts on the
+    MXU.  This replaces the segstats route, which materialized a
+    [n, E*num_segments*S] operand in HBM — ~700 MB per wave at the
+    108-config sweep's shape (E=30, W=42) and the measured reason
+    fused-cv rounds cost ~100x their FLOPs.  Per-element tiles are small
+    (the fold is [chunk, K]), so the only re-read across elements is the
+    bins block — negligible next to the matmul.
+
+    int8 is not supported here (per-element quantization scales would be
+    needed); callers route that mode to the segstats/XLA path.
+    """
+    e, n, s = stats.shape
+    num_features = bins.shape[1]
+    k = num_segments * s
+    if hist_dtype == "f32x":
+        hist_dtype = "f32"
+    if hist_dtype == "int8":
+        raise ValueError("hist_fused_pallas_batched does not support int8")
+
+    # feature blocking: per-(element, block) accumulator [F_blk, B, K] must
+    # fit scoped VMEM alongside the folded operand and one-hot tiles
+    f_blk = num_features
+    while f_blk > 1 and f_blk * num_bins * k * 4 > 6 * 1024 * 1024:
+        f_blk = -(-f_blk // 2)
+    if f_blk != num_features:
+        f_blk = max(8, f_blk // 8 * 8)
+    n_fblk = -(-num_features // f_blk)
+    f_pad = n_fblk * f_blk - num_features
+    if chunk is None:
+        out_bytes = f_blk * num_bins * k * 4
+        budget = 11 * 1024 * 1024 - out_bytes
+        per_row = 4 * num_bins + 20 * k + 8 * f_blk + 64
+        chunk = max(256, min(2048, budget // max(per_row, 1)))
+        chunk = int(chunk) // 256 * 256 or 256
+
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+
+    bins_t = bins.astype(jnp.int32).T                       # [F, n]
+    seg_id = seg_id.astype(jnp.int32)
+    seg_id = jnp.where((seg_id >= 0) & (seg_id < num_segments), seg_id, -1)
+
+    n_chunks = -(-n // chunk)
+    pad = n_chunks * chunk - n
+    if pad or f_pad:
+        bins_t = jnp.pad(bins_t, ((0, f_pad), (0, pad)))
+        stats = jnp.pad(stats, ((0, 0), (0, pad), (0, 0)))
+        seg_id = jnp.pad(seg_id, ((0, 0), (0, pad)), constant_values=-1)
+    n_pad_rows = n_chunks * chunk
+
+    # flat [. , E*n] layouts with rows on the 128-lane minor dim (see
+    # _fused_kernel layout note); the index maps pick the element via
+    # block-column arithmetic
+    stats_flat = stats.transpose(2, 0, 1).reshape(s, e * n_pad_rows)
+    seg_flat = seg_id.reshape(1, e * n_pad_rows)
+
+    def one_pass(stats_arr, mode):
+        return pl.pallas_call(
+            functools.partial(_fused_kernel, num_features=num_features,
+                              num_bins=num_bins, num_segments=num_segments,
+                              hist_dtype=mode, chunk_dim=2),
+            grid=(e, n_fblk, n_chunks),
+            in_specs=[
+                pl.BlockSpec((f_blk, chunk), lambda el, fb, c: (fb, c),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((s, chunk),
+                             lambda el, fb, c, nc=n_chunks: (0, el * nc + c),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, chunk),
+                             lambda el, fb, c, nc=n_chunks: (0, el * nc + c),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(
+                (f_blk, num_bins, k),
+                lambda el, fb, c, nf=n_fblk: (el * nf + fb, 0, 0),
+                memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct(
+                (e * n_fblk * f_blk, num_bins, k), jnp.float32),
+            interpret=interpret,
+        )(bins_t, stats_arr, seg_flat)
+
+    if hist_dtype == "f32":
+        hi = stats_flat.astype(jnp.bfloat16).astype(jnp.float32)
+        out = one_pass(hi, "bf16") + one_pass(stats_flat - hi, "bf16")
+    else:
+        out = one_pass(stats_flat, hist_dtype)
+    out = out.reshape(e, n_fblk * f_blk, num_bins, k)[:, :num_features]
+    out = out.reshape(e, num_features, num_bins, num_segments, s)
+    return out.transpose(0, 3, 1, 2, 4)

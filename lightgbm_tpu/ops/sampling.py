@@ -78,7 +78,10 @@ def sample_bag(key, row_mask, fraction, n_valid):
     u = jax.random.uniform(key, row_mask.shape)
     valid = row_mask > 0
     k = jnp.floor(fraction * n_valid).astype(jnp.int32)
-    take = approx_top_mask(jnp.where(valid, 1.0 - u, 0.0), valid, k)
+    # uniform keys have no heavy tail, so one refinement pass suffices
+    # (the 2-pass default exists for outlier GRADIENTS in GOSS)
+    take = approx_top_mask(jnp.where(valid, 1.0 - u, 0.0), valid, k,
+                           passes=1)
     keep = jnp.where((k > 0) & (fraction < 1.0), take, valid)
     return keep.astype(jnp.float32)
 

@@ -118,12 +118,19 @@ class LambdaRank(Objective):
         table = _label_gain_table(self.params.label_gain, max_label)
         gains = np.where(valid, table[labels.astype(np.int64)], 0.0)
         inv_max = _inverse_max_dcg(gains, valid, self.truncation)
+        sizes = np.asarray(group_sizes, np.int64)
         self._packed = dict(
             doc_idx=jnp.asarray(doc_idx),
             valid=jnp.asarray(valid),
             gains=jnp.asarray(gains, jnp.float32),
             inv_max=jnp.asarray(inv_max, jnp.float32),
             n_padded=n_padded,
+            # uniform query size U: the [Q, G] layout maps to the flat row
+            # axis by reshape+pad alone, replacing the [n]-sized gather and
+            # scatter-add (measured ~11 ms/round at the MSLR shape — 30x
+            # the pairwise math itself) with free relayouts
+            uniform=(int(sizes[0]) if len(sizes) and
+                     (sizes == sizes[0]).all() else None),
         )
 
     # -- device pairwise lambdas ----------------------------------------
@@ -138,8 +145,13 @@ class LambdaRank(Objective):
         q, g = doc_idx.shape
         sigma = jnp.float32(self.sigma)
         trunc = jnp.int32(self.truncation)
+        uni = pk.get("uniform")
 
-        scores = pred[doc_idx]                                   # [Q, G]
+        if uni is not None:    # reshape+pad instead of a row gather
+            scores = jnp.pad(pred[:q * uni].reshape(q, uni),
+                             ((0, 0), (0, g - uni)))
+        else:
+            scores = pred[doc_idx]                               # [Q, G]
         ranks = _ranks_desc(scores, valid)                       # [Q, G]
         disc = 1.0 / jnp.log2(2.0 + ranks.astype(jnp.float32))   # [Q, G]
 
@@ -194,11 +206,17 @@ class LambdaRank(Objective):
         h_q = h_q.reshape(-1, g)[:q]
 
         n_pad = pred.shape[0]
-        safe = jnp.where(valid, doc_idx, n_pad)
-        grad = jnp.zeros(n_pad, jnp.float32).at[safe.reshape(-1)].add(
-            (g_q * valid).reshape(-1), mode="drop")
-        hess = jnp.zeros(n_pad, jnp.float32).at[safe.reshape(-1)].add(
-            (h_q * valid).reshape(-1), mode="drop")
+        if uni is not None:    # inverse of the reshape+pad above
+            grad = jnp.pad((g_q * valid)[:, :uni].reshape(-1),
+                           (0, n_pad - q * uni))
+            hess = jnp.pad((h_q * valid)[:, :uni].reshape(-1),
+                           (0, n_pad - q * uni))
+        else:
+            safe = jnp.where(valid, doc_idx, n_pad)
+            grad = jnp.zeros(n_pad, jnp.float32).at[safe.reshape(-1)].add(
+                (g_q * valid).reshape(-1), mode="drop")
+            hess = jnp.zeros(n_pad, jnp.float32).at[safe.reshape(-1)].add(
+                (h_q * valid).reshape(-1), mode="drop")
         hess = jnp.maximum(hess, 2e-3)  # LightGBM min hessian floor for rank
         return grad * w, hess * w
 
