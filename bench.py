@@ -454,7 +454,11 @@ def bench_higgs_parity_auc(n=1_000_000, n_rounds=100, num_leaves=127):
     ds.construct()
     b = lgb.Booster(params, ds)
     b.update_many(n_rounds)
-    p_tpu = np.asarray(b.predict(Xv, num_iteration=n_rounds))
+    # chunked prediction: smaller dispatches lower the per-attempt odds
+    # of the intermittent worker fault this section is exposed to
+    p_tpu = np.concatenate([
+        np.asarray(b.predict(Xv[i:i + 250_000], num_iteration=n_rounds))
+        for i in range(0, len(Xv), 250_000)])
 
     orc, _cpu_s = _fit_cpu_oracle(X, y, n_rounds, num_leaves)
     p_cpu = orc.predict_proba(Xv)[:, 1]
@@ -473,6 +477,7 @@ def bench_higgs_parity_auc(n=1_000_000, n_rounds=100, num_leaves=127):
         diffs.append(roc_auc_score(yb, p_cpu[idx])
                      - roc_auc_score(yb, p_tpu[idx]))
     return {
+        "higgs_parity_rows": n,
         "higgs_parity_rounds": n_rounds,
         "higgs_auc_parity_config": round(auc_tpu, 5),
         "higgs_auc_parity_oracle": round(auc_cpu, 5),
@@ -591,8 +596,12 @@ def main() -> None:
             "higgs_section(11_000_000, 30, 'higgs11m', False)", 900,
             retries=1)
     section("mslr", "bench_mslr()", 600)
+    # near-strict configs crash the remote worker with ~50% probability
+    # per 1M-row attempt (PERF.md known issue); the 500k tier is reliably
+    # below the crash zone and the PAIRED gap stays apples-to-apples
     section("higgs_parity", ["bench_higgs_parity_auc()",
-                             "bench_higgs_parity_auc(1_000_000, 40)"], 900)
+                             "bench_higgs_parity_auc(1_000_000, 40)",
+                             "bench_higgs_parity_auc(500_000, 100)"], 900)
     section("criteo_efb", "bench_criteo_efb()", 600)
     if not quick:
         section("higgs11m_quality",

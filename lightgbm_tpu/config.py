@@ -484,15 +484,20 @@ def parse_params(
                 continue
             merged[k] = v
     # preset="parity": CPU-reference quality mode (VERDICT r3 #3).  The
-    # strict leaf-wise grower reproduces LightGBM's exact best-first split
-    # ORDER (the wave scheduler's tail reordering costs ~1e-3 AUC on the
-    # Higgs shape); histograms stay on the bf16 MXU path (measured ~2e-4
-    # AUC vs f32, whose full-rate mode is unstable at >=1M rows on this
-    # worker — PERF.md known issue).  Explicit user keys still win.
+    # "half" wave tail grows the tree in near-strict best-first order
+    # (the greedy tail's reordering costs ~1.1e-3 AUC on the Higgs
+    # shape), and histograms run EXACT f32 (Precision.HIGHEST) on the
+    # XLA path — which also sidesteps this worker's known Pallas fault
+    # under the half-tail invocation pattern (PERF.md; r4 measured the
+    # XLA path clean at 100 rounds x 1M rows where pallas+half crashed
+    # ~50% per attempt).  True-strict order (grow_policy="leafwise")
+    # remains available but is the most crash-prone config on this
+    # worker.  Explicit user keys still win over every preset default.
     preset = str(merged.pop("preset", "")).lower()
     if preset == "parity":
-        merged.setdefault("grow_policy", "leafwise")
         merged.setdefault("wave_tail", "half")
+        merged.setdefault("hist_dtype", "f32")
+        merged.setdefault("hist_impl", "jnp")
     elif preset:
         warnings.warn(f"Unknown preset '{preset}' ignored", stacklevel=2)
     for key, value in merged.items():

@@ -196,19 +196,23 @@ def _fused_cv_fn(obj_key: tuple, num_leaves: int, num_bins: int,
     return run_segment, init_carry, finalize
 
 
-def _fused_wave_width(p: Params, n_pad: int) -> int:
+def _fused_wave_width(p: Params, n_pad: int, hist_dtype: str) -> int:
     """Wave width for the BATCHED regime: strict growth below ~2^19 rows.
 
     With the configs x folds batch axis already amortizing per-pass fixed
     costs, waves' extra FLOPs and per-wave partition work LOSE at small n
     (measured r4: nl=127 strict 192 ms/round vs waves 368 ms at the
     46k-row sweep shape; at 1M rows the trade flips, same as the host
-    path).  An EXPLICIT grow_policy or wave_width still wins — cv must
-    grow trees the same way the user's final training will.
+    path).  Exact-f32 ("f32x") and int8 dtypes also stay strict: they are
+    excluded from the wide-segment batched kernel, and the segstats
+    fallback at wave width materializes [n, E*W*S] in HBM (~15 GB at the
+    1M-row 30-element shape).  An EXPLICIT grow_policy or wave_width
+    still wins — cv must grow trees the way the final training will.
     """
     explicit = (p.grow_policy != "auto"
                 or int(p.extra.get("wave_width", 0)) != 0)
-    if not explicit and n_pad < (1 << 19):
+    if not explicit and (n_pad < (1 << 19)
+                        or hist_dtype in ("f32x", "int8")):
         return 1
     from .gbdt import resolve_wave_width
     return resolve_wave_width(p, n_pad)
@@ -339,7 +343,8 @@ def run_fused_cv_batch(
         num_boost_round, int(bagging_freq),
         n_configs, n_folds, p0.extra.get("hist_impl", "auto"),
         int(p0.extra.get("row_chunk", 131072)),
-        resolve_hist_dtype(p0, n_pad), cat_key, num_class, _fused_wave_width(p0, n_pad))
+        resolve_hist_dtype(p0, n_pad), cat_key, num_class,
+        _fused_wave_width(p0, n_pad, resolve_hist_dtype(p0, n_pad)))
 
     tm_d = jnp.asarray(tm)
     carry = init_carry(n_pad, jnp.asarray(init, jnp.float32)

@@ -236,6 +236,45 @@ def _fused_kernel(bins_ref, stats_ref, seg_ref, out_ref, *,
     lax.fori_loop(0, bins_ref.shape[0], body, 0)
 
 
+def _vmem_blocking(num_features: int, num_bins: int, k: int,
+                   chunk_align: int = 512):
+    """Shared VMEM sizing for the fused kernels: (f_blk, n_fblk, f_pad,
+    chunk).
+
+    The [F_blk, B, K] f32 accumulator stays VMEM-resident; when the full
+    feature axis does not fit (MSLR's 136 features x 128 lanes ~= 18 MB),
+    features split into grid-major blocks — stats/seg tiles are re-read
+    once per block, a negligible cost next to the matmul.  All budgets
+    use the LANE-PADDED k: VMEM tiles are (8, 128), so a k=3 root pass
+    occupies 128 lanes per bin — at Criteo's 413 raw features that is a
+    54 MB accumulator if sized from the nominal k (the r3 criteo
+    efb_off OOM).
+    """
+    k_pad = -(-k // 128) * 128
+    f_blk = num_features
+    while f_blk > 1 and f_blk * num_bins * k_pad * 4 > 6 * 1024 * 1024:
+        f_blk = -(-f_blk // 2)
+    if f_blk != num_features:
+        # blocked second-to-last dims must be multiples of 8 (Mosaic
+        # tiling); round DOWN so the VMEM budget the loop just enforced
+        # cannot be re-violated (rounding up re-grew a 34-feature block
+        # to 40 and overflowed the 16 MB scope at the MSLR shape)
+        f_blk = max(8, f_blk // 8 * 8)
+    n_fblk = -(-num_features // f_blk)
+    f_pad = n_fblk * f_blk - num_features
+    # per-chunk tiles (one-hot B*chunk*2, folded stats chunk*K*2 x 2
+    # passes + f32 spread temporaries, bins chunk*F_blk*4, masks) with
+    # input double-buffering; the per-row estimate is deliberately fat —
+    # a too-small chunk costs a few % of MXU efficiency, a too-big one
+    # fails compile
+    out_bytes = f_blk * num_bins * k_pad * 4
+    budget = 11 * 1024 * 1024 - out_bytes
+    per_row = 4 * num_bins + 20 * k + 8 * f_blk + 64
+    chunk = max(chunk_align, min(2048, budget // max(per_row, 1)))
+    chunk = int(chunk) // chunk_align * chunk_align or chunk_align
+    return f_blk, n_fblk, f_pad, chunk
+
+
 def hist_fused_pallas(
     bins: jnp.ndarray,
     stats: jnp.ndarray,
@@ -262,33 +301,10 @@ def hist_fused_pallas(
             f"hist_dtype='int8' is limited to 16M rows per device shard "
             f"(got n={n}): the int32 bin accumulator can overflow. "
             f"Use hist_dtype='bf16' or shard rows across devices.")
-    # VMEM (16 MB scoped limit on v5e): the [F_blk, B, K] f32 accumulator
-    # stays resident; when the full feature axis does not fit (MSLR's 136
-    # features x 128 lanes ~= 18 MB), features split into grid-major blocks
-    # — stats/seg tiles are re-read once per block, a negligible cost next
-    # to the matmul.
-    f_blk = num_features
-    while f_blk > 1 and f_blk * num_bins * k * 4 > 6 * 1024 * 1024:
-        f_blk = -(-f_blk // 2)
-    if f_blk != num_features:
-        # blocked second-to-last dims must be multiples of 8 (Mosaic
-        # tiling); round DOWN so the VMEM budget the loop just enforced
-        # cannot be re-violated (rounding up re-grew a 34-feature block to
-        # 40 and overflowed the 16 MB scope at the MSLR shape)
-        f_blk = max(8, f_blk // 8 * 8)
-    n_fblk = -(-num_features // f_blk)
-    f_pad = n_fblk * f_blk - num_features
+    f_blk, n_fblk, f_pad, auto_chunk = _vmem_blocking(
+        num_features, num_bins, k, chunk_align=512)
     if chunk is None:
-        # per-chunk tiles (one-hot B*chunk*2, folded stats chunk*K*2 x 2
-        # passes + f32 spread temporaries, bins chunk*F_blk*4, masks) with
-        # input double-buffering; the per-row estimate is deliberately fat —
-        # a too-small chunk costs a few % of MXU efficiency, a too-big one
-        # fails compile
-        out_bytes = f_blk * num_bins * k * 4
-        budget = 11 * 1024 * 1024 - out_bytes
-        per_row = 4 * num_bins + 20 * k + 8 * f_blk + 64
-        chunk = max(512, min(2048, budget // max(per_row, 1)))
-        chunk = int(chunk) // 512 * 512 or 512
+        chunk = auto_chunk
         if hist_dtype == "int8":
             # Mosaic widens int8 intermediates aggressively (measured 43 MB
             # of scoped VMEM at chunk=2048 vs ~14 MB for the bf16 path)
@@ -409,21 +425,10 @@ def hist_fused_pallas_batched(
     if hist_dtype == "int8":
         raise ValueError("hist_fused_pallas_batched does not support int8")
 
-    # feature blocking: per-(element, block) accumulator [F_blk, B, K] must
-    # fit scoped VMEM alongside the folded operand and one-hot tiles
-    f_blk = num_features
-    while f_blk > 1 and f_blk * num_bins * k * 4 > 6 * 1024 * 1024:
-        f_blk = -(-f_blk // 2)
-    if f_blk != num_features:
-        f_blk = max(8, f_blk // 8 * 8)
-    n_fblk = -(-num_features // f_blk)
-    f_pad = n_fblk * f_blk - num_features
+    f_blk, n_fblk, f_pad, auto_chunk = _vmem_blocking(
+        num_features, num_bins, k, chunk_align=256)
     if chunk is None:
-        out_bytes = f_blk * num_bins * k * 4
-        budget = 11 * 1024 * 1024 - out_bytes
-        per_row = 4 * num_bins + 20 * k + 8 * f_blk + 64
-        chunk = max(256, min(2048, budget // max(per_row, 1)))
-        chunk = int(chunk) // 256 * 256 or 256
+        chunk = auto_chunk
 
     if interpret is None:
         interpret = jax.default_backend() == "cpu"

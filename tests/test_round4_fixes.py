@@ -105,15 +105,27 @@ def test_batched_fused_kernel_parity():
                                    rtol=2e-4, atol=2e-4)
 
 
-def test_parity_preset_expands_to_strict_grower():
+def test_parity_preset_expands_to_quality_config():
+    import warnings as _w
+
     from lightgbm_tpu.config import parse_params
 
     p = parse_params({"objective": "binary", "preset": "parity"})
-    assert p.grow_policy == "leafwise"
+    # near-strict tail + EXACT f32 histograms on the XLA path (the
+    # pallas kernel faults under near-strict invocation patterns on the
+    # current worker — PERF.md)
+    assert p.extra.get("wave_tail") == "half"
+    assert p.extra.get("hist_dtype") == "f32"
+    assert p.extra.get("hist_impl") == "jnp"
     # explicit user keys still win over the preset
     p2 = parse_params({"objective": "binary", "preset": "parity",
-                       "grow_policy": "frontier"})
-    assert p2.grow_policy == "frontier"
+                       "wave_tail": "greedy"})
+    assert p2.extra.get("wave_tail") == "greedy"
+    # unknown preset names warn instead of vanishing silently
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        parse_params({"objective": "binary", "preset": "partiy"})
+    assert any("preset" in str(r.message) for r in rec)
 
 
 def test_fused_cv_multiclass_matches_host_loop():
