@@ -77,7 +77,7 @@ def _fused_cv_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                  bagging_freq: int, n_configs: int, n_folds: int,
                  hist_impl: str, row_chunk: int, hist_dtype: str = "f32",
                  cat_key: Optional[tuple] = None, num_class: int = 1,
-                 wave_width: int = 1):
+                 wave_width: int = 1, bynode_off: bool = False):
     """Build the jitted fused-cv program for one static configuration."""
     obj = _rebuild_objective(obj_key)
     metric = get_metric(metric_name,
@@ -104,7 +104,9 @@ def _fused_cv_fn(obj_key: tuple, num_leaves: int, num_bins: int,
             stats = jnp.stack([gc * bag, hc * bag, bag], axis=-1)
             return grow_tree(
                 bins, stats, fmask, hyper.ctx(), num_leaves, num_bins,
-                hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
+                hyper.max_depth,
+                ff_bynode=(None if bynode_off
+                           else hyper.feature_fraction_bynode),
                 key=kc, hist_impl=hist_impl,
                 row_chunk=row_chunk, hist_dtype=hist_dtype,
                 wave_width=wave_width,
@@ -337,14 +339,16 @@ def run_fused_cv_batch(
     cat_key = ((tuple(int(c) for c in cats), float(p0.cat_smooth),
                 float(p0.cat_l2), int(p0.max_cat_threshold))
                if len(cats) else None)
+    hd = resolve_hist_dtype(p0, n_pad)
     run_segment, init_carry, finalize = _fused_cv_fn(
         _objective_static_key(obj, p0), p0.num_leaves, train_set.num_bins,
         metric_name, float(p0.alpha), float(p0.tweedie_variance_power),
         num_boost_round, int(bagging_freq),
         n_configs, n_folds, p0.extra.get("hist_impl", "auto"),
         int(p0.extra.get("row_chunk", 131072)),
-        resolve_hist_dtype(p0, n_pad), cat_key, num_class,
-        _fused_wave_width(p0, n_pad, resolve_hist_dtype(p0, n_pad)))
+        hd, cat_key, num_class, _fused_wave_width(p0, n_pad, hd),
+        bynode_off=all(p.feature_fraction_bynode >= 1.0
+                       for p in param_list))
 
     tm_d = jnp.asarray(tm)
     carry = init_carry(n_pad, jnp.asarray(init, jnp.float32)

@@ -216,7 +216,8 @@ def _goss_compact_round(bins, y, w, bag, pred, fmask, hyper: HyperScalars,
                         row_chunk, hist_dtype, wave_width, cat_info,
                         renew_alpha, axis_name=None, sample_key=None,
                         mono=None, extra_trees=False, col_bins=None,
-                        renew_scale=None, ic_member=None):
+                        renew_scale=None, ic_member=None,
+                        bynode_off=False):
     """One compacted GOSS round (shared by the per-round and scanned paths
     — the two MUST stay in RNG lockstep for fused == host training).
 
@@ -264,7 +265,7 @@ def _goss_compact_round(bins, y, w, bag, pred, fmask, hyper: HyperScalars,
     stats = jnp.stack([g[idx] * wt, h[idx] * wt, live], axis=-1)
     tree, rl_c = grow_tree(
         bins_c, stats, fmask, hyper.ctx(), num_leaves, num_bins,
-        hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode, key=key,
+        hyper.max_depth, ff_bynode=(None if bynode_off else hyper.feature_fraction_bynode), key=key,
         hist_impl=hist_impl, row_chunk=row_chunk, hist_dtype=hist_dtype,
         wave_width=wave_width, cat_info=cat_info, axis_name=axis_name,
         mono=mono, extra_trees=extra_trees, col_bins=col_bins,
@@ -289,11 +290,15 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
               mono_key: Optional[tuple] = None, extra_trees: bool = False,
               nbins_key: Optional[tuple] = None,
               linear_k: Optional[int] = None,
-              ic_key: Optional[tuple] = None):
+              ic_key: Optional[tuple] = None,
+              bynode_off: bool = False):
     """goss_k: static (k_top, k_other) row counts enabling the compacted
     GOSS path; None = plain gbdt/rf.  cat_key: static categorical-split
     configuration (see _build_cat_info).  mono_key: static per-feature
-    monotone constraints tuple (upstream ``monotone_constraints``)."""
+    monotone constraints tuple (upstream ``monotone_constraints``).
+    bynode_off: statically true when feature_fraction_bynode == 1.0 — the
+    growers then skip the per-node threefry draw entirely (kernel-count
+    savings at small shapes)."""
     obj = _rebuild_objective(obj_key)
     is_goss = goss_k is not None
     renew_alpha = getattr(obj, "renew_alpha", None)
@@ -328,7 +333,7 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                 return grow_tree(
                     bins, stats, feature_mask, hyper.ctx(), num_leaves,
                     num_bins, hyper.max_depth,
-                    ff_bynode=hyper.feature_fraction_bynode, key=kc,
+                    ff_bynode=(None if bynode_off else hyper.feature_fraction_bynode), key=kc,
                     hist_impl=hist_impl, row_chunk=row_chunk,
                     hist_dtype=hist_dtype, wave_width=wave_width,
                     cat_info=_build_cat_info(cat_key, bins.shape[1]),
@@ -357,7 +362,8 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                 hist_dtype, wave_width,
                 _build_cat_info(cat_key, bins.shape[1]), renew_alpha,
                 mono=mono_arr, extra_trees=extra_trees, col_bins=colb,
-                renew_scale=renew_scale, ic_member=ic_member)
+                renew_scale=renew_scale, ic_member=ic_member,
+                bynode_off=bynode_off)
 
         return round_fn_goss
 
@@ -377,7 +383,7 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
             tree, row_leaf = grow_tree(
                 bins, stats, feature_mask, hyper.ctx(), num_leaves,
                 num_bins, hyper.max_depth,
-                ff_bynode=hyper.feature_fraction_bynode,
+                ff_bynode=(None if bynode_off else hyper.feature_fraction_bynode),
                 key=key, hist_impl=hist_impl, row_chunk=row_chunk,
                 hist_dtype=hist_dtype, wave_width=wave_width,
                 cat_info=_build_cat_info(cat_key, bins.shape[1]),
@@ -399,7 +405,7 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                           axis=-1)
         tree, row_leaf = grow_tree(
             bins, stats, feature_mask, hyper.ctx(), num_leaves, num_bins,
-            hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
+            hyper.max_depth, ff_bynode=(None if bynode_off else hyper.feature_fraction_bynode),
             key=key, hist_impl=hist_impl, row_chunk=row_chunk,
             hist_dtype=hist_dtype, wave_width=wave_width,
             cat_info=_build_cat_info(cat_key, bins.shape[1]),
@@ -426,7 +432,8 @@ def _multi_round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                     mono_key: Optional[tuple] = None,
                     extra_trees: bool = False,
                     nbins_key: Optional[tuple] = None,
-                    ic_key: Optional[tuple] = None):
+                    ic_key: Optional[tuple] = None,
+                    bynode_off: bool = False):
     """``n_rounds`` boosting rounds as ONE device program (`lax.scan`).
 
     The host round loop pays a dispatch round-trip per boosting round —
@@ -479,13 +486,14 @@ def _multi_round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                     goss_k, num_leaves, num_bins, hist_impl, row_chunk,
                     hist_dtype, wave_width, cat_info, renew_alpha,
                     mono=mono_arr, extra_trees=extra_trees, col_bins=colb,
-                    renew_scale=renew_scale, ic_member=ic_member)
+                    renew_scale=renew_scale, ic_member=ic_member,
+                    bynode_off=bynode_off)
                 return (new_pred, bag), tree
             stats = jnp.stack(
                 [g * bag, h * bag, (bag > 0).astype(jnp.float32)], axis=-1)
             tree, row_leaf = grow_tree(
                 bins, stats, fmask, hyper.ctx(), num_leaves, num_bins,
-                hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
+                hyper.max_depth, ff_bynode=(None if bynode_off else hyper.feature_fraction_bynode),
                 key=rkey, hist_impl=hist_impl,
                 row_chunk=row_chunk, hist_dtype=hist_dtype,
                 wave_width=wave_width,
@@ -1262,7 +1270,8 @@ class Booster:
                            resolve_hist_dtype(p, eff_rows),
                            resolve_wave_width(p, eff_rows), goss_k,
                            self._cat_key, self._mono_key, p.extra_trees,
-                           self._nbins_key, self._linear_k, self._ic_key)
+                           self._nbins_key, self._linear_k, self._ic_key,
+                           bynode_off=p.feature_fraction_bynode >= 1.0)
             if self._linear_k is not None:
                 tree, new_pred = fn(ds.X_binned, ds.y, self._w_eff,
                                     self._bag, self._pred_train, fmask,
@@ -1356,7 +1365,8 @@ class Booster:
                 resolve_wave_width(p, eff_rows), n_rounds,
                 p.bagging_freq if use_bagging else 0, use_ff,
                 self._cat_key, goss_k, self._mono_key, p.extra_trees,
-                self._nbins_key, self._ic_key)
+                self._nbins_key, self._ic_key,
+                bynode_off=p.feature_fraction_bynode >= 1.0)
             pred, bag, trees = fn(
                 ds.X_binned, ds.y, self._w_eff, self._bag, self._pred_train,
                 self._hyper, self._key, bag_key, ff_key, ds.row_mask,
@@ -1436,7 +1446,8 @@ class Booster:
                        resolve_hist_dtype(p, eff_rows),
                        resolve_wave_width(p, eff_rows), None, self._cat_key,
                        self._mono_key, p.extra_trees, self._nbins_key,
-                       None, self._ic_key)
+                       None, self._ic_key,
+                       bynode_off=p.feature_fraction_bynode >= 1.0)
         round_key = jax.random.fold_in(self._key, i)
         tree, new_pred = fn(ds.X_binned, ds.y, self._w_eff, self._bag, pred,
                             fmask, self._hyper, round_key)

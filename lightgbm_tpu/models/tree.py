@@ -74,39 +74,52 @@ class Tree(NamedTuple):
         return self.split_feature.shape[-1]
 
 
+class _PK:
+    """Column layout of the strict grower's packed per-node table.
+
+    The strict grower's per-split bookkeeping used to live in 22 separate
+    ``[capacity]`` arrays; at small n the fused-cv sweep is bound by KERNEL
+    COUNT, not FLOPs (PERF.md r4 finding 3), and the 15 tiny per-field
+    gathers plus ~44 per-field masked scatters per split iteration were
+    most of its while-body kernels.  One f32 ``[capacity, NC]`` table makes
+    that ONE row gather and THREE row scatters per iteration.  Integer
+    fields (node ids <= capacity, feature ids, bin ids <= 256, depth) are
+    all exactly representable in f32.
+    """
+
+    SPLIT_FEAT = 0    # init -1
+    SPLIT_BIN = 1
+    LEFT = 2          # init -1
+    RIGHT = 3         # init -1
+    LEAF_VALUE = 4
+    IS_LEAF = 5       # 0/1
+    COUNT = 6
+    SPLIT_GAIN = 7
+    DEPTH = 8
+    CAND_GAIN = 9     # init -inf
+    CAND_FEAT = 10
+    CAND_BIN = 11
+    CAND_LG = 12
+    CAND_LH = 13
+    CAND_LC = 14
+    CAND_RG = 15
+    CAND_RH = 16
+    CAND_RC = 17
+    CAND_WL = 18
+    CAND_WR = 19
+    BOUND_LO = 20     # init -inf
+    BOUND_HI = 21     # init +inf
+    CAND_CAT = 22     # 0/1 (unused when the dataset has no categoricals)
+    NC = 23
+
+
 class _GrowState(NamedTuple):
-    # tree under construction
-    split_feature: jnp.ndarray
-    split_bin: jnp.ndarray
-    left: jnp.ndarray
-    right: jnp.ndarray
-    leaf_value: jnp.ndarray
-    is_leaf: jnp.ndarray
-    count: jnp.ndarray
-    split_gain: jnp.ndarray
-    depth: jnp.ndarray          # i32[M]
-    # cached best candidate split per created node
-    cand_gain: jnp.ndarray      # f32[M] (-inf when invalid)
-    cand_feat: jnp.ndarray      # i32[M]
-    cand_bin: jnp.ndarray       # i32[M]
-    cand_lg: jnp.ndarray
-    cand_lh: jnp.ndarray
-    cand_lc: jnp.ndarray
-    cand_rg: jnp.ndarray
-    cand_rh: jnp.ndarray
-    cand_rc: jnp.ndarray
-    # constrained child outputs + monotone ancestor bounds per node
-    cand_wl: jnp.ndarray        # f32[M]
-    cand_wr: jnp.ndarray        # f32[M]
-    bound_lo: jnp.ndarray       # f32[M]
-    bound_hi: jnp.ndarray       # f32[M]
-    # dynamic growth state
+    nodes: jnp.ndarray          # f32[M, _PK.NC] packed per-node table
     row_leaf: jnp.ndarray       # i32[n]
     n_nodes: jnp.ndarray        # i32[]
     n_leaves: jnp.ndarray       # i32[]
     done: jnp.ndarray           # bool[]
-    # categorical candidate splits (None when the dataset has none)
-    cand_cat: Optional[jnp.ndarray] = None      # bool[M]
+    # categorical candidate split masks (None when the dataset has none)
     cand_catmask: Optional[jnp.ndarray] = None  # bool[M, B]
     # interaction constraints: surviving group set per node (None = off)
     ic_sets: Optional[jnp.ndarray] = None       # bool[M, NG]
@@ -355,13 +368,16 @@ def grow_tree(
     neg_inf = jnp.float32(-jnp.inf)
     if key is None:
         key = jax.random.PRNGKey(0)
-    if ff_bynode is None:
-        ff_bynode = jnp.float32(1.0)
+    bynode_off = ff_bynode is None   # static: skip the per-node RNG draw
 
     def node_feature_mask(node_id):
         """Per-node column subsample drawn WITHIN the per-tree subset
         (LightGBM samples bynode from the tree-sampled set, so a node can
-        never end up with zero usable features)."""
+        never end up with zero usable features).  When bynode sampling is
+        statically off, every node uses the tree mask directly — the
+        threefry draw would be ~20 wasted kernels per split iteration."""
+        if bynode_off:
+            return feature_mask
         from ..ops.sampling import sample_feature_mask
 
         return sample_feature_mask(jax.random.fold_in(key, node_id),
@@ -408,38 +424,36 @@ def grow_tree(
     if fp_axis is not None:
         root_best = _fp_reduce_best(root_best, fp_axis, num_features)
 
-    def full(val, dtype):
-        return jnp.full((capacity,), val, dtype)
-
+    K = _PK
+    nodes0 = jnp.zeros((capacity, K.NC), jnp.float32)
+    nodes0 = nodes0.at[:, K.SPLIT_FEAT].set(-1.0)
+    nodes0 = nodes0.at[:, K.LEFT].set(-1.0)
+    nodes0 = nodes0.at[:, K.RIGHT].set(-1.0)
+    nodes0 = nodes0.at[:, K.CAND_GAIN].set(neg_inf)
+    nodes0 = nodes0.at[:, K.BOUND_LO].set(-jnp.inf)
+    nodes0 = nodes0.at[:, K.BOUND_HI].set(jnp.inf)
+    root_row = jnp.zeros((K.NC,), jnp.float32)
+    root_row = root_row.at[jnp.array([
+        K.SPLIT_FEAT, K.LEFT, K.RIGHT, K.LEAF_VALUE, K.IS_LEAF, K.COUNT,
+        K.CAND_GAIN, K.CAND_FEAT, K.CAND_BIN, K.CAND_LG, K.CAND_LH,
+        K.CAND_LC, K.CAND_RG, K.CAND_RH, K.CAND_RC, K.CAND_WL, K.CAND_WR,
+        K.BOUND_LO, K.BOUND_HI, K.CAND_CAT])].set(jnp.stack([
+            jnp.float32(-1.0), jnp.float32(-1.0), jnp.float32(-1.0),
+            root_out, jnp.float32(1.0), root_tot[2],
+            root_best.gain, root_best.feature.astype(jnp.float32),
+            root_best.bin.astype(jnp.float32), root_best.left_g,
+            root_best.left_h, root_best.left_c, root_best.right_g,
+            root_best.right_h, root_best.right_c, root_best.left_out,
+            root_best.right_out, jnp.float32(-jnp.inf),
+            jnp.float32(jnp.inf),
+            (root_best.cat.astype(jnp.float32) if cat_info is not None
+             else jnp.float32(0.0))]))
     st = _GrowState(
-        split_feature=full(-1, jnp.int32),
-        split_bin=full(0, jnp.int32),
-        left=full(-1, jnp.int32),
-        right=full(-1, jnp.int32),
-        leaf_value=full(0.0, jnp.float32).at[0].set(root_out),
-        is_leaf=full(False, jnp.bool_).at[0].set(True),
-        count=full(0.0, jnp.float32).at[0].set(root_tot[2]),
-        split_gain=full(0.0, jnp.float32),
-        depth=full(0, jnp.int32),
-        cand_gain=full(neg_inf, jnp.float32).at[0].set(root_best.gain),
-        cand_feat=full(0, jnp.int32).at[0].set(root_best.feature),
-        cand_bin=full(0, jnp.int32).at[0].set(root_best.bin),
-        cand_lg=full(0.0, jnp.float32).at[0].set(root_best.left_g),
-        cand_lh=full(0.0, jnp.float32).at[0].set(root_best.left_h),
-        cand_lc=full(0.0, jnp.float32).at[0].set(root_best.left_c),
-        cand_rg=full(0.0, jnp.float32).at[0].set(root_best.right_g),
-        cand_rh=full(0.0, jnp.float32).at[0].set(root_best.right_h),
-        cand_rc=full(0.0, jnp.float32).at[0].set(root_best.right_c),
-        cand_wl=full(0.0, jnp.float32).at[0].set(root_best.left_out),
-        cand_wr=full(0.0, jnp.float32).at[0].set(root_best.right_out),
-        bound_lo=full(-jnp.inf, jnp.float32),
-        bound_hi=full(jnp.inf, jnp.float32),
+        nodes=nodes0.at[0].set(root_row),
         row_leaf=jnp.zeros(n, jnp.int32),
         n_nodes=jnp.int32(1),
         n_leaves=jnp.int32(1),
         done=jnp.bool_(False),
-        cand_cat=(None if cat_info is None else
-                  full(False, jnp.bool_).at[0].set(root_best.cat)),
         cand_catmask=(None if cat_info is None else
                       jnp.zeros((capacity, num_bins), jnp.bool_)
                       .at[0].set(root_best.cat_mask)),
@@ -451,16 +465,18 @@ def grow_tree(
     bins_i32 = bins.astype(jnp.int32)
 
     def body(_, st: _GrowState) -> _GrowState:
+        P = st.nodes
         # 1. pick the active leaf with the best cached gain (best-first).
-        gains = jnp.where(st.is_leaf, st.cand_gain, neg_inf)
+        gains = jnp.where(P[:, K.IS_LEAF] > 0.5, P[:, K.CAND_GAIN], neg_inf)
         leaf = jnp.argmax(gains).astype(jnp.int32)
         gain = gains[leaf]
         active = (~st.done) & jnp.isfinite(gain)
 
         nl = st.n_nodes
         nr = st.n_nodes + 1
-        feat = st.cand_feat[leaf]
-        thr = st.cand_bin[leaf]
+        row = P[leaf]                       # [NC] — ONE gather for every
+        feat = row[K.CAND_FEAT].astype(jnp.int32)   # cached scalar below
+        thr = row[K.CAND_BIN].astype(jnp.int32)
 
         # 2. partition rows of the split leaf (gather, no pointer chasing).
         if fp_axis is not None:
@@ -470,7 +486,7 @@ def grow_tree(
         if cat_info is None:
             go_left = col <= thr
         else:
-            go_left = jnp.where(st.cand_cat[leaf],
+            go_left = jnp.where(row[K.CAND_CAT] > 0.5,
                                 st.cand_catmask[leaf][col], col <= thr)
         new_rl = jnp.where(
             st.row_leaf == leaf, jnp.where(go_left, nl, nr), st.row_leaf)
@@ -482,15 +498,16 @@ def grow_tree(
         hist2 = hist_fn(seg, 2)                                  # [2, F, B, 3]
 
         # 4. child output bounds (monotone basic method).
-        wl_v, wr_v = st.cand_wl[leaf], st.cand_wr[leaf]
-        lo, hi = st.bound_lo[leaf], st.bound_hi[leaf]
+        wl_v, wr_v = row[K.CAND_WL], row[K.CAND_WR]
+        lo, hi = row[K.BOUND_LO], row[K.BOUND_HI]
         lo_l, hi_l, lo_r, hi_r = _mono_child_bounds(mono, feat, wl_v, wr_v,
                                                     lo, hi)
 
         # 5. candidate splits for the children (each child samples its own
         # per-node feature subset when feature_fraction_bynode < 1).
-        child_depth = st.depth[leaf] + 1
-        depth_ok = (max_depth <= 0) | (child_depth < max_depth)
+        child_depth = row[K.DEPTH] + 1.0
+        depth_ok = (max_depth <= 0) | \
+            (child_depth < max_depth.astype(jnp.float32))
         child_masks = jnp.stack([node_feature_mask(nl), node_feature_mask(nr)])
         if ic_member is not None:
             child_sets = st.ic_sets[leaf] & ic_member[:, feat]   # [NG]
@@ -520,82 +537,74 @@ def grow_tree(
             bs = jax.vmap(
                 lambda b: _fp_reduce_best(b, fp_axis, num_features))(bs)
 
-        lg, lh, lc = st.cand_lg[leaf], st.cand_lh[leaf], st.cand_lc[leaf]
-        rg, rh, rc = st.cand_rg[leaf], st.cand_rh[leaf], st.cand_rc[leaf]
+        # 6. three packed row writes: the split leaf becomes internal, the
+        # two children arrive with their cached candidate splits.
+        leaf_row = row.at[jnp.array([
+            K.SPLIT_FEAT, K.SPLIT_BIN, K.LEFT, K.RIGHT, K.IS_LEAF,
+            K.SPLIT_GAIN])].set(jnp.stack([
+                feat.astype(jnp.float32), thr.astype(jnp.float32),
+                nl.astype(jnp.float32), nr.astype(jnp.float32),
+                jnp.float32(0.0), gain]))
+        two = lambda a, b: jnp.stack([a, b])
+        child_rows = jnp.stack([
+            jnp.full((2,), -1.0),                        # SPLIT_FEAT
+            jnp.zeros((2,)),                             # SPLIT_BIN
+            jnp.full((2,), -1.0),                        # LEFT
+            jnp.full((2,), -1.0),                        # RIGHT
+            two(wl_v, wr_v),                             # LEAF_VALUE
+            jnp.ones((2,)),                              # IS_LEAF
+            two(row[K.CAND_LC], row[K.CAND_RC]),         # COUNT
+            jnp.zeros((2,)),                             # SPLIT_GAIN
+            jnp.full((2,), child_depth),                 # DEPTH
+            bs.gain,                                     # CAND_GAIN
+            bs.feature.astype(jnp.float32),              # CAND_FEAT
+            bs.bin.astype(jnp.float32),                  # CAND_BIN
+            bs.left_g, bs.left_h, bs.left_c,
+            bs.right_g, bs.right_h, bs.right_c,
+            bs.left_out,                                 # CAND_WL
+            bs.right_out,                                # CAND_WR
+            two(lo_l, lo_r),                             # BOUND_LO
+            two(hi_l, hi_r),                             # BOUND_HI
+            (bs.cat.astype(jnp.float32) if cat_info is not None
+             else jnp.zeros((2,))),                      # CAND_CAT
+        ], axis=-1)                                      # [2, NC]
+        oob = jnp.int32(capacity)
+        P = P.at[jnp.where(active, leaf, oob)].set(leaf_row, mode="drop")
+        kid_idx = jnp.where(active, jnp.stack([nl, nr]), oob)
+        P = P.at[kid_idx].set(child_rows, mode="drop")
 
-        new = st._replace(
-            split_feature=_write(st.split_feature, leaf, feat, active),
-            split_bin=_write(st.split_bin, leaf, thr, active),
-            left=_write(st.left, leaf, nl, active),
-            right=_write(st.right, leaf, nr, active),
-            split_gain=_write(st.split_gain, leaf, gain, active),
-            is_leaf=_write(
-                _write(_write(st.is_leaf, leaf, False, active),
-                       nl, True, active),
-                nr, True, active),
-            leaf_value=_write(
-                _write(st.leaf_value, nl, wl_v, active),
-                nr, wr_v, active),
-            count=_write(_write(st.count, nl, lc, active), nr, rc, active),
-            depth=_write(_write(st.depth, nl, child_depth, active),
-                         nr, child_depth, active),
-            cand_gain=_write(_write(st.cand_gain, nl, bs.gain[0], active),
-                             nr, bs.gain[1], active),
-            cand_feat=_write(_write(st.cand_feat, nl, bs.feature[0], active),
-                             nr, bs.feature[1], active),
-            cand_bin=_write(_write(st.cand_bin, nl, bs.bin[0], active),
-                            nr, bs.bin[1], active),
-            cand_lg=_write(_write(st.cand_lg, nl, bs.left_g[0], active),
-                           nr, bs.left_g[1], active),
-            cand_lh=_write(_write(st.cand_lh, nl, bs.left_h[0], active),
-                           nr, bs.left_h[1], active),
-            cand_lc=_write(_write(st.cand_lc, nl, bs.left_c[0], active),
-                           nr, bs.left_c[1], active),
-            cand_rg=_write(_write(st.cand_rg, nl, bs.right_g[0], active),
-                           nr, bs.right_g[1], active),
-            cand_rh=_write(_write(st.cand_rh, nl, bs.right_h[0], active),
-                           nr, bs.right_h[1], active),
-            cand_rc=_write(_write(st.cand_rc, nl, bs.right_c[0], active),
-                           nr, bs.right_c[1], active),
-            cand_wl=_write(_write(st.cand_wl, nl, bs.left_out[0], active),
-                           nr, bs.left_out[1], active),
-            cand_wr=_write(_write(st.cand_wr, nl, bs.right_out[0], active),
-                           nr, bs.right_out[1], active),
-            bound_lo=_write(_write(st.bound_lo, nl, lo_l, active),
-                            nr, lo_r, active),
-            bound_hi=_write(_write(st.bound_hi, nl, hi_l, active),
-                            nr, hi_r, active),
+        return st._replace(
+            nodes=P,
             row_leaf=row_leaf,
             n_nodes=st.n_nodes + jnp.where(active, 2, 0).astype(jnp.int32),
             n_leaves=st.n_leaves + jnp.where(active, 1, 0).astype(jnp.int32),
             done=st.done | ~jnp.isfinite(gain),
-            cand_cat=(None if cat_info is None else _write(
-                _write(st.cand_cat, nl, bs.cat[0], active),
-                nr, bs.cat[1], active)),
-            cand_catmask=(None if cat_info is None else _write(
-                _write(st.cand_catmask, nl, bs.cat_mask[0], active),
-                nr, bs.cat_mask[1], active)),
-            ic_sets=(None if ic_member is None else _write(
-                _write(st.ic_sets, nl, child_sets, active),
-                nr, child_sets, active)),
+            cand_catmask=(None if cat_info is None else
+                          st.cand_catmask.at[kid_idx].set(
+                              bs.cat_mask, mode="drop")),
+            ic_sets=(None if ic_member is None else
+                     st.ic_sets.at[kid_idx].set(
+                         jnp.stack([child_sets, child_sets]), mode="drop")),
         )
-        return new
 
     st = lax.fori_loop(0, num_leaves - 1, body, st)
 
-    internal = (~st.is_leaf) & (st.left >= 0)
+    P = st.nodes
+    is_leaf = P[:, K.IS_LEAF] > 0.5
+    left = P[:, K.LEFT].astype(jnp.int32)
+    internal = (~is_leaf) & (left >= 0)
     tree = Tree(
-        split_feature=st.split_feature,
-        split_bin=st.split_bin,
-        left=st.left,
-        right=st.right,
-        leaf_value=st.leaf_value,
-        is_leaf=st.is_leaf,
-        count=st.count,
-        split_gain=st.split_gain,
+        split_feature=P[:, K.SPLIT_FEAT].astype(jnp.int32),
+        split_bin=P[:, K.SPLIT_BIN].astype(jnp.int32),
+        left=left,
+        right=P[:, K.RIGHT].astype(jnp.int32),
+        leaf_value=P[:, K.LEAF_VALUE],
+        is_leaf=is_leaf,
+        count=P[:, K.COUNT],
+        split_gain=P[:, K.SPLIT_GAIN],
         num_leaves=st.n_leaves,
         is_cat_split=(None if cat_info is None
-                      else internal & st.cand_cat),
+                      else internal & (P[:, K.CAND_CAT] > 0.5)),
         cat_mask=(None if cat_info is None else st.cand_catmask),
     )
     return tree, st.row_leaf
@@ -709,10 +718,11 @@ def grow_tree_frontier(
     neg_inf = jnp.float32(-jnp.inf)
     if key is None:
         key = jax.random.PRNGKey(0)
-    if ff_bynode is None:
-        ff_bynode = jnp.float32(1.0)
+    bynode_off = ff_bynode is None   # static: skip the per-node RNG draw
 
     def node_feature_mask(node_id):
+        if bynode_off:
+            return feature_mask
         from ..ops.sampling import sample_feature_mask
 
         return sample_feature_mask(jax.random.fold_in(key, node_id),

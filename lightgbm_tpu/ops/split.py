@@ -213,12 +213,17 @@ def find_best_split(
         flat_idx = jnp.argmax(gain.reshape(-1))
         feat = (flat_idx // num_bins).astype(jnp.int32)
         bin_idx = (flat_idx % num_bins).astype(jnp.int32)
+        # ONE packed gather for all 8 winner statistics (separate per-field
+        # gathers were a kernel launch each — the strict grower's split
+        # iteration is kernel-count-bound at sweep shapes, PERF.md r4)
+        packed = jnp.stack([lg, lh, lc, rg, rh, rc, wl, wr],
+                           axis=-1)                       # [F, B, 8]
+        win = packed[feat, bin_idx]                       # [8]
         return BestSplit(
-            gain=gain.reshape(-1)[flat_idx], feature=feat, bin=bin_idx,
-            left_g=lg[feat, bin_idx], left_h=lh[feat, bin_idx],
-            left_c=lc[feat, bin_idx], right_g=rg[feat, bin_idx],
-            right_h=rh[feat, bin_idx], right_c=rc[feat, bin_idx],
-            left_out=wl[feat, bin_idx], right_out=wr[feat, bin_idx])
+            gain=jnp.max(gain), feature=feat, bin=bin_idx,
+            left_g=win[0], left_h=win[1], left_c=win[2],
+            right_g=win[3], right_h=win[4], right_c=win[5],
+            left_out=win[6], right_out=win[7])
 
     is_cat = cat_info.is_cat
     # Fisher ordering: bins ranked by grad/(hess + cat_smooth); empty bins

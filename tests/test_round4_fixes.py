@@ -111,16 +111,16 @@ def test_parity_preset_expands_to_quality_config():
     from lightgbm_tpu.config import parse_params
 
     p = parse_params({"objective": "binary", "preset": "parity"})
-    # near-strict tail + EXACT f32 histograms on the XLA path (the
-    # pallas kernel faults under near-strict invocation patterns on the
-    # current worker — PERF.md)
-    assert p.extra.get("wave_tail") == "half"
+    # TRUE-STRICT order + EXACT f32 histograms on the XLA path (strict on
+    # jnp is clean on this worker — the intermittent fault follows
+    # strict+pallas; PERF.md "AUC parity — NORTH STAR MET")
+    assert p.grow_policy == "leafwise"
     assert p.extra.get("hist_dtype") == "f32"
     assert p.extra.get("hist_impl") == "jnp"
     # explicit user keys still win over the preset
     p2 = parse_params({"objective": "binary", "preset": "parity",
-                       "wave_tail": "greedy"})
-    assert p2.extra.get("wave_tail") == "greedy"
+                       "grow_policy": "frontier"})
+    assert p2.grow_policy == "frontier"
     # unknown preset names warn instead of vanishing silently
     with _w.catch_warnings(record=True) as rec:
         _w.simplefilter("always")
