@@ -126,7 +126,8 @@ def _fused_cv_fn(obj_key: tuple, num_leaves: int, num_bins: int,
     @jax.jit
     def run_segment(carry: FusedCVCarry, seg_end, bins, y, w, train_masks,
                     valid_masks, hyper_b: HyperScalars, bag_frac_b, ff_b,
-                    n_in_fold_b, es_rounds, base_key) -> FusedCVCarry:
+                    n_in_fold_b, es_rounds, es_min_delta_c,
+                    base_key) -> FusedCVCarry:
         """Run rounds [carry.r, seg_end) — bounded per-dispatch runtime so a
         multi-minute cv batch is many short device programs, not one long
         one (long single executions can trip TPU runtime watchdogs), while
@@ -159,7 +160,10 @@ def _fused_cv_fn(obj_key: tuple, num_leaves: int, num_bins: int,
 
             mean_by_cfg = mvals.reshape(n_configs, n_folds).mean(axis=1)
             score = sign * mean_by_cfg
-            improved = (score > c.best_score) & ~c.done
+            # early_stopping_min_delta (per config, traced): an improvement
+            # only counts when it beats the incumbent by more than the
+            # tolerance — callback.early_stopping's compare, on device
+            improved = (score > c.best_score + es_min_delta_c) & ~c.done
             best_score = jnp.where(improved, score, c.best_score)
             best_iter = jnp.where(improved, r, c.best_iter)
             stalled = (r - best_iter >= es_rounds) & (es_rounds > 0)
@@ -240,9 +244,6 @@ def fused_cv_eligible(p: Params, feval, callbacks, train_set=None) -> bool:
     if len(metrics) > 1:
         return False
     if p.boosting not in ("gbdt",):
-        return False
-    if p.early_stopping_min_delta != 0.0:
-        # the fused while-loop early stop compares without a tolerance
         return False
     if p.monotone_constraints is not None or p.extra_trees \
             or p.linear_tree or p.interaction_constraints:
@@ -358,6 +359,8 @@ def run_fused_cv_batch(
     carry = carry._replace(bag=tm_d)
     args = (tm_d, jnp.asarray(vm), hyper_b, bag_frac_b, ff_b,
             jnp.asarray(n_in_fold), jnp.int32(early_stopping_rounds),
+            jnp.asarray([p.early_stopping_min_delta for p in param_list],
+                        jnp.float32),
             jax.random.PRNGKey(seed))
     seg = int(p0.extra.get("cv_segment_rounds", 100))
     import time as _time

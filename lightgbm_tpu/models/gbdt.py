@@ -1011,20 +1011,23 @@ class Booster:
         import warnings
 
         p = self.params
-        if (self._num_class > 1 or p.boosting in ("goss", "dart")
+        # (multiclass and categorical are fp-supported since r4: the class
+        # axis vmaps inside the shard_map and the static is_cat mask
+        # slices per shard — make_fp_train_step)
+        if (p.boosting in ("goss", "dart")
                 or p.linear_tree
                 or getattr(self.obj, "needs_group", False)
                 or getattr(self.obj, "renew_alpha", None) is not None
-                or self._cat_key is not None
                 or self._mono_key is not None or p.extra_trees
                 or self._ic_key is not None
                 or p.feature_fraction_bynode < 1.0):
             warnings.warn(
-                "tree_learner='feature' currently supports single-output "
-                "non-ranking, non-categorical, unconstrained gbdt/rf "
-                "without per-node feature sampling (bynode would sample "
-                "per SHARD and diverge from serial); training serially",
-                stacklevel=3)
+                "tree_learner='feature' currently supports gbdt/rf "
+                "(single or multiclass, with categoricals) without "
+                "monotone/interaction constraints, extra_trees, goss, "
+                "dart, linear_tree, ranking, or per-node feature "
+                "sampling (bynode would sample per SHARD and diverge "
+                "from serial); training serially", stacklevel=3)
             return
         n_dev = len(jax.devices())
         if n_dev <= 1:
@@ -1210,7 +1213,8 @@ class Booster:
                 self._fp_mesh, self._obj_key, p.num_leaves, self._num_bins,
                 p.extra.get("hist_impl", "auto"),
                 int(p.extra.get("row_chunk", 131072)), p.boosting == "rf",
-                resolve_hist_dtype(p, eff_rows))
+                resolve_hist_dtype(p, eff_rows), self._num_class,
+                self._cat_key)
             pad_cols = self._fp_width - int(fmask.shape[0])
             fmask_p = jnp.concatenate(
                 [fmask, jnp.zeros(pad_cols, jnp.float32)]) \

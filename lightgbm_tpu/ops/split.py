@@ -213,17 +213,21 @@ def find_best_split(
         flat_idx = jnp.argmax(gain.reshape(-1))
         feat = (flat_idx // num_bins).astype(jnp.int32)
         bin_idx = (flat_idx % num_bins).astype(jnp.int32)
-        # ONE packed gather for all 8 winner statistics (separate per-field
-        # gathers were a kernel launch each — the strict grower's split
-        # iteration is kernel-count-bound at sweep shapes, PERF.md r4)
-        packed = jnp.stack([lg, lh, lc, rg, rh, rc, wl, wr],
-                           axis=-1)                       # [F, B, 8]
-        win = packed[feat, bin_idx]                       # [8]
+        # 4 gathers instead of 10, with NO materialized re-pack: the left
+        # (g,h,c) triple comes straight out of the existing cumsum tensor
+        # in one gather, the right triple is total - left, and only the
+        # two child outputs gather separately.  (A [F,B,8] stacked re-pack
+        # would be one gather fewer but materializes ~35 MB per call once
+        # the frontier grower vmaps this over its wave segments; the
+        # strict sweep path is kernel-count-bound, PERF.md r4.)
+        win_l = cum[feat, bin_idx]                        # [3] (g, h, c)
+        tot = total[feat, 0]                              # [3]
+        win_r = tot - win_l
         return BestSplit(
             gain=jnp.max(gain), feature=feat, bin=bin_idx,
-            left_g=win[0], left_h=win[1], left_c=win[2],
-            right_g=win[3], right_h=win[4], right_c=win[5],
-            left_out=win[6], right_out=win[7])
+            left_g=win_l[0], left_h=win_l[1], left_c=win_l[2],
+            right_g=win_r[0], right_h=win_r[1], right_c=win_r[2],
+            left_out=wl[feat, bin_idx], right_out=wr[feat, bin_idx])
 
     is_cat = cat_info.is_cat
     # Fisher ordering: bins ranked by grad/(hess + cat_smooth); empty bins

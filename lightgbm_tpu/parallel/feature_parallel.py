@@ -69,23 +69,58 @@ def shard_features(mesh: Mesh, bins, fmask):
 def make_fp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                        num_bins: int, hist_impl: str = "auto",
                        row_chunk: int = 131072, is_rf: bool = False,
-                       hist_dtype: str = "f32"):
+                       hist_dtype: str = "f32", num_class: int = 1,
+                       cat_key=None):
     """Build the jitted feature-parallel round step for a mesh.
 
     step(bins_fsharded, y, w, bag, pred, fmask_fsharded, hyper, key) ->
     (tree [replicated], new_pred [replicated]).
+
+    ``num_class > 1`` vmaps the class axis over the grower INSIDE the
+    shard_map (one tree per class per round, exactly like the dp
+    learner's step_mc — the per-class split-exchange all_gathers batch
+    into one collective).  ``cat_key`` enables categorical k-vs-rest
+    splits: the static global is_cat mask is sliced to each shard's
+    column range (cat_key indices are GLOBAL training columns), the
+    winning subset mask rides the split exchange like any other
+    BestSplit field, and the partition's category-membership test runs
+    on the psum-broadcast global column.
     """
+    from ..models.gbdt import _build_cat_info
+
     obj = _rebuild_objective(obj_key)
+    n_shards = mesh.shape[FEATURE_AXIS]
+
+    def local_cat_info(f_local):
+        if cat_key is None:
+            return None
+        full = _build_cat_info(cat_key, f_local * n_shards)
+        shard = jax.lax.axis_index(FEATURE_AXIS)
+        return full._replace(is_cat=jax.lax.dynamic_slice(
+            full.is_cat, (shard * f_local,), (f_local,)))
 
     def step(bins_l, y, w, bag, pred, fmask_l, hyper: HyperScalars, key):
-        g, h = obj.grad_hess(pred, y, w)
-        stats = jnp.stack([g * bag, h * bag, (bag > 0).astype(jnp.float32)],
-                          axis=-1)
-        tree, row_leaf = grow_tree(
-            bins_l, stats, fmask_l, hyper.ctx(), num_leaves, num_bins,
-            hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
-            key=key, hist_impl=hist_impl, row_chunk=row_chunk,
-            hist_dtype=hist_dtype, wave_width=1, fp_axis=FEATURE_AXIS)
+        cat_l = local_cat_info(bins_l.shape[1])
+        g, h = obj.grad_hess(pred, y, w)          # [n] or [n, K]
+
+        def grow_one(gc, hc, kc):
+            stats = jnp.stack([gc * bag, hc * bag,
+                               (bag > 0).astype(jnp.float32)], axis=-1)
+            return grow_tree(
+                bins_l, stats, fmask_l, hyper.ctx(), num_leaves, num_bins,
+                hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
+                key=kc, hist_impl=hist_impl, row_chunk=row_chunk,
+                hist_dtype=hist_dtype, wave_width=1, fp_axis=FEATURE_AXIS,
+                cat_info=cat_l)
+
+        if num_class > 1:
+            keys = jax.random.split(key, num_class)
+            trees, row_leafs = jax.vmap(grow_one, in_axes=(1, 1, 0))(
+                g, h, keys)                        # leading [K] axis
+            deltas = jax.vmap(lambda t, rl: lookup_values(
+                rl, t.leaf_value))(trees, row_leafs)
+            return trees, pred + hyper.learning_rate * deltas.T
+        tree, row_leaf = grow_one(g, h, key)
         shrink = jnp.where(is_rf, 1.0, hyper.learning_rate)
         new_pred = pred + shrink * lookup_values(row_leaf, tree.leaf_value)
         return tree, new_pred

@@ -123,6 +123,8 @@ def _bounded_compile_state():
 # in ONE reviewable place next to the measured durations that justify it.
 # ---------------------------------------------------------------------------
 _SLOW_TESTS = {
+    "test_fp_categorical_matches_serial",
+    "test_fp_multiclass_matches_serial",
     "test_bagging_and_feature_fraction_run",
     "test_beats_linear_model",
     "test_binary_objective_auc",

@@ -394,3 +394,57 @@ def test_2d_mesh_dp_fp_composition_matches_serial():
         np.testing.assert_allclose(np.asarray(ts.leaf_value),
                                    np.asarray(td.leaf_value),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_fp_multiclass_matches_serial():
+    """tree_learner='feature' with multiclass (fp-supported since r4): the
+    class axis vmaps inside the shard_map — per-class split-exchange
+    all_gathers batch into one collective — and must match serial."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(31)
+    n, F, K = 1024, 10, 3
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = (np.argmax(X[:, :K] + 0.3 * rng.normal(size=(n, K)), axis=1)
+         .astype(np.float32))
+    params = {"objective": "multiclass", "num_class": K, "num_leaves": 7,
+              "verbosity": -1, "min_data_in_leaf": 5,
+              "grow_policy": "leafwise"}
+    b_serial = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    b_fp = lgb.train({**params, "tree_learner": "feature"},
+                     lgb.Dataset(X, label=y), num_boost_round=5)
+    assert b_fp._fp_mesh is not None, "FP path must engage on the 8-dev mesh"
+    np.testing.assert_allclose(b_serial.predict(X[:100]),
+                               b_fp.predict(X[:100]), rtol=1e-5, atol=1e-6)
+
+
+def test_fp_categorical_matches_serial():
+    """tree_learner='feature' with categorical k-vs-rest splits
+    (fp-supported since r4): the static is_cat mask slices per shard and
+    the winning subset mask rides the split exchange; must match serial."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(37)
+    n = 2000
+    cat = rng.integers(0, 12, n).astype(np.float32)
+    Xnum = rng.normal(size=(n, 9)).astype(np.float32)
+    X = np.column_stack([cat, Xnum])
+    effect = np.array([1.5, -2.0, 0.3, 2.2, -0.7, 0.0, 1.0, -1.2, 0.5,
+                       -0.2, 0.8, -1.6])
+    y = (effect[cat.astype(int)] + Xnum[:, 0]
+         + rng.normal(0, 0.1, n)).astype(np.float32)
+    params = {"objective": "regression", "num_leaves": 15,
+              "learning_rate": 0.2, "verbosity": -1,
+              "grow_policy": "leafwise"}
+    serial = lgb.train(dict(params),
+                       lgb.Dataset(X, label=y, categorical_feature=[0]),
+                       num_boost_round=8)
+    fp = lgb.train(dict(params, tree_learner="feature"),
+                   lgb.Dataset(X, label=y, categorical_feature=[0]),
+                   num_boost_round=8)
+    assert fp._fp_mesh is not None, "FP path must engage on the 8-dev mesh"
+    for ts, tf in zip(serial.trees, fp.trees):
+        np.testing.assert_array_equal(np.asarray(ts.split_feature),
+                                      np.asarray(tf.split_feature))
+    np.testing.assert_allclose(serial.predict(X), fp.predict(X),
+                               rtol=1e-5, atol=1e-5)

@@ -72,7 +72,9 @@ def main():
     carry = init_carry(n_pad, jnp.zeros((batch,), jnp.float32))
     carry = carry._replace(bag=jnp.asarray(tm))
     args = (jnp.asarray(tm), jnp.asarray(vm), hyper_b, rep(0.8), rep(1.0),
-            jnp.asarray(n_in_fold), jnp.int32(0), jax.random.PRNGKey(0))
+            jnp.asarray(n_in_fold), jnp.int32(0),
+            jnp.zeros((n_configs,), jnp.float32),   # es_min_delta_c
+            jax.random.PRNGKey(0))
 
     lowered = run_segment.lower(carry, jnp.int32(10), ds.X_binned, ds.y,
                                 ds.w, *args)
