@@ -457,6 +457,48 @@ def bench_criteo_efb(n=200_000, n_sparse=400, n_dense=13, n_rounds=30):
     return out
 
 
+def bench_higgs_goss(n=1_000_000, n_rounds=100, num_leaves=127):
+    """GOSS at the Higgs shape — upstream LightGBM's own algorithmic
+    answer to histogram cost (``boosting=goss``: top-20% |gradient| rows
+    + an amplified 10% sample = 3.3x shorter MXU contraction per pass).
+    Device throughput is slope-timed like the plain section and the AUC
+    is scored against the SAME plain CPU oracle; keys are labeled goss
+    and never merged into the plain-config numbers — the reader sees
+    what the sampled config trades (AUC delta) for its speed."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils.datasets import make_higgs_like
+    from sklearn.metrics import roc_auc_score
+
+    X, y = make_higgs_like(n)
+    Xv, yv = make_higgs_like(1_000_000, seed=9)
+    # shorter dispatches than the plain section: the GOSS round's
+    # compaction gathers stack on the histogram work and a 14-round
+    # 1M-row GOSS dispatch crashed the remote worker (r4 session 2)
+    k1, k2 = (3, 8) if n <= 2_000_000 else (2, 4)
+    params = {"objective": "binary", "boosting": "goss",
+              "num_leaves": num_leaves, "learning_rate": 0.1,
+              "verbosity": -1, "min_data_in_leaf": 20,
+              "top_rate": 0.2, "other_rate": 0.1,
+              "fused_segment_rounds": k2}
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    b = lgb.Booster(params, ds)
+    dev_s_round = _device_rounds_slope(b, k1, k2)
+
+    b2 = lgb.Booster(params, ds)
+    b2.update_many(n_rounds)
+    p_tpu = np.concatenate([
+        np.asarray(b2.predict(Xv[i:i + 250_000], num_iteration=n_rounds))
+        for i in range(0, len(Xv), 250_000)])
+    auc = float(roc_auc_score(yv, p_tpu))
+    return {
+        "higgs_goss_rows": n,
+        "higgs_goss_rounds": n_rounds,
+        "higgs_goss_device_rows_per_s": round(n / dev_s_round, 1),
+        "higgs_goss_auc": round(auc, 5),
+    }
+
+
 def bench_higgs_parity_auc(n=1_000_000, n_rounds=100, num_leaves=127):
     """PAIRED quality comparison of the parity preset vs the CPU oracle.
 
@@ -596,6 +638,11 @@ def main() -> None:
                 try:
                     out.update(_in_subprocess(
                         expr, int(min(timeout, rem - 30))))
+                    # terminal health NEXT TO each section's numbers: the
+                    # tunnel's round trip has moved 0.08 -> ~100 ms within
+                    # one session (PERF.md), and wall-clock keys are
+                    # unreadable without knowing which terminal ran them
+                    out[f"{label}_dispatch_ms"] = _dispatch_latency_ms()
                     emit()
                     return
                 except Exception as e:  # noqa: BLE001 — artifact > purity
@@ -637,6 +684,9 @@ def main() -> None:
                 ["higgs_quality_section(11_000_000, 30, 'higgs11m')",
                  "higgs_quality_section(11_000_000, 10, 'higgs11m')"],
                 900)
+    # LAST: GOSS crashed the remote worker once (r4 session 2) — a fault
+    # here costs nothing but this section's own keys
+    section("higgs_goss", "bench_higgs_goss()", 600)
     emit()
 
 

@@ -113,12 +113,12 @@ def _fused_cv_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                 cat_info=_build_cat_info(cat_key, num_features))
 
         if num_class > 1:
-            keys = jax.random.split(jax.random.fold_in(key, 2), num_class)
-            trees, row_leafs = jax.vmap(grow_one, in_axes=(1, 1, 0))(
-                g, h, keys)                           # leading [K] axis
-            deltas = jax.vmap(lambda t, rl: lookup_values(
-                rl, t.leaf_value))(trees, row_leafs)  # [K, n]
-            return pred + hyper.learning_rate * deltas.T
+            from .gbdt import mc_round_update
+            _, new_pred = mc_round_update(
+                grow_one, g, h,
+                jax.random.split(jax.random.fold_in(key, 2), num_class),
+                pred, hyper.learning_rate)
+            return new_pred
         tree, row_leaf = grow_one(g, h, jax.random.fold_in(key, 2))
         return pred + hyper.learning_rate * lookup_values(
             row_leaf, tree.leaf_value)

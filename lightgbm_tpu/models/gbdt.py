@@ -281,6 +281,22 @@ def _goss_compact_round(bins, y, w, bag, pred, fmask, hyper: HyperScalars,
     return tree, new_pred
 
 
+
+def mc_round_update(grow_one, g, h, keys, pred, learning_rate):
+    """Shared multiclass round: one tree per class via a vmapped grower.
+
+    The class axis vmaps over ``grow_one`` (per-class histogram psums /
+    split-exchange all_gathers batch into one collective under mesh
+    learners), and the prediction update is one batched
+    ``leaf_value[row_leaf]`` lookup.  Callers own their RNG chain: the
+    ``keys`` argument must already match the host loop's fold/split
+    sequence, or fused/mesh training would diverge from serial."""
+    trees, row_leafs = jax.vmap(grow_one, in_axes=(1, 1, 0))(g, h, keys)
+    deltas = jax.vmap(lambda t, rl: lookup_values(
+        rl, t.leaf_value))(trees, row_leafs)            # [K, n]
+    return trees, pred + learning_rate * deltas.T
+
+
 @functools.lru_cache(maxsize=None)
 def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
               hist_impl: str, row_chunk: int, is_rf: bool,
@@ -340,13 +356,9 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                     mono=mono_arr, extra_trees=extra_trees, col_bins=colb,
                     ic_member=ic_member)
 
-            keys = jax.random.split(key, num_class)
-            trees, row_leafs = jax.vmap(grow_one, in_axes=(1, 1, 0))(
-                g, h, keys)                            # leading [K] axis
-            deltas = jax.vmap(lambda t, rl: lookup_values(
-                rl, t.leaf_value))(trees, row_leafs)   # [K, n]
-            new_pred = pred + hyper.learning_rate * deltas.T
-            return trees, new_pred
+            return mc_round_update(grow_one, g, h,
+                                   jax.random.split(key, num_class), pred,
+                                   hyper.learning_rate)
 
         return round_fn_mc
 
@@ -1345,9 +1357,18 @@ class Booster:
         # default segment length scales inversely with row count so one
         # dispatch stays a few device-seconds at most (very long single
         # executions crash/restart the remote TPU worker); big data pays
-        # per-dispatch overhead rarely anyway — compute dominates there
+        # per-dispatch overhead rarely anyway — compute dominates there.
+        # TINY shapes (rows x features <= 2^20 cells — the diamonds
+        # regime) fuse up to 200 rounds into ONE dispatch: device time
+        # stays well under a second, and per-dispatch round trips are the
+        # entire wall-clock story there (~100 ms each through a sick
+        # tunnel x 8 segments was most of the r4 diamonds budget)
         n_pad = int(ds.row_mask.shape[0])
-        seg_default = max(1, min(25, (1 << 22) // max(n_pad, 1)))
+        cells = n_pad * max(int(ds.X_binned.shape[1]), 1)
+        if cells <= (1 << 20):
+            seg_default = max(1, min(200, (1 << 24) // max(n_pad, 1)))
+        else:
+            seg_default = max(1, min(25, (1 << 22) // max(n_pad, 1)))
         seg = max(1, int(p.extra.get("fused_segment_rounds", seg_default)))
         use_bagging = p.bagging_freq > 0 and p.bagging_fraction < 1.0
         use_ff = p.feature_fraction < 1.0

@@ -141,11 +141,10 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                 extra_trees=extra_trees, col_bins=colb,
                 ic_member=ic_member, cat_info=make_cat(bins.shape[1]))
 
-        keys = jax.random.split(key, num_class)
-        trees, row_leafs = jax.vmap(grow_one, in_axes=(1, 1, 0))(g, h, keys)
-        deltas = jax.vmap(lambda t, rl: lookup_values(
-            rl, t.leaf_value))(trees, row_leafs)
-        return trees, pred + hyper.learning_rate * deltas.T
+        from ..models.gbdt import mc_round_update
+        return mc_round_update(grow_one, g, h,
+                               jax.random.split(key, num_class), pred,
+                               hyper.learning_rate)
 
     def step(bins, y, w, bag, pred, feature_mask, hyper: HyperScalars, key):
         g, h = obj.grad_hess(pred, y, w)

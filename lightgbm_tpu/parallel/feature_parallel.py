@@ -114,12 +114,10 @@ def make_fp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                 cat_info=cat_l)
 
         if num_class > 1:
-            keys = jax.random.split(key, num_class)
-            trees, row_leafs = jax.vmap(grow_one, in_axes=(1, 1, 0))(
-                g, h, keys)                        # leading [K] axis
-            deltas = jax.vmap(lambda t, rl: lookup_values(
-                rl, t.leaf_value))(trees, row_leafs)
-            return trees, pred + hyper.learning_rate * deltas.T
+            from ..models.gbdt import mc_round_update
+            return mc_round_update(grow_one, g, h,
+                                   jax.random.split(key, num_class), pred,
+                                   hyper.learning_rate)
         tree, row_leaf = grow_one(g, h, key)
         shrink = jnp.where(is_rf, 1.0, hyper.learning_rate)
         new_pred = pred + shrink * lookup_values(row_leaf, tree.leaf_value)
