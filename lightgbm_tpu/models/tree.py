@@ -130,6 +130,59 @@ def _write(arr, idx, val, active):
     return arr.at[idx].set(jnp.where(active, val, arr[idx]))
 
 
+def _packed_root_table(capacity, root_out, root_tot, root_best,
+                       cat_info) -> jnp.ndarray:
+    """Initial packed [capacity, _PK.NC] node table with the root's row set
+    (shared by the strict and frontier growers)."""
+    K = _PK
+    nodes0 = jnp.zeros((capacity, K.NC), jnp.float32)
+    nodes0 = nodes0.at[:, K.SPLIT_FEAT].set(-1.0)
+    nodes0 = nodes0.at[:, K.LEFT].set(-1.0)
+    nodes0 = nodes0.at[:, K.RIGHT].set(-1.0)
+    nodes0 = nodes0.at[:, K.CAND_GAIN].set(-jnp.inf)
+    nodes0 = nodes0.at[:, K.BOUND_LO].set(-jnp.inf)
+    nodes0 = nodes0.at[:, K.BOUND_HI].set(jnp.inf)
+    root_row = jnp.zeros((K.NC,), jnp.float32)
+    root_row = root_row.at[jnp.array([
+        K.SPLIT_FEAT, K.LEFT, K.RIGHT, K.LEAF_VALUE, K.IS_LEAF, K.COUNT,
+        K.CAND_GAIN, K.CAND_FEAT, K.CAND_BIN, K.CAND_LG, K.CAND_LH,
+        K.CAND_LC, K.CAND_RG, K.CAND_RH, K.CAND_RC, K.CAND_WL, K.CAND_WR,
+        K.BOUND_LO, K.BOUND_HI, K.CAND_CAT])].set(jnp.stack([
+            jnp.float32(-1.0), jnp.float32(-1.0), jnp.float32(-1.0),
+            root_out, jnp.float32(1.0), root_tot[2],
+            root_best.gain, root_best.feature.astype(jnp.float32),
+            root_best.bin.astype(jnp.float32), root_best.left_g,
+            root_best.left_h, root_best.left_c, root_best.right_g,
+            root_best.right_h, root_best.right_c, root_best.left_out,
+            root_best.right_out, jnp.float32(-jnp.inf),
+            jnp.float32(jnp.inf),
+            (root_best.cat.astype(jnp.float32) if cat_info is not None
+             else jnp.float32(0.0))]))
+    return nodes0.at[0].set(root_row)
+
+
+def _tree_from_packed(P, n_leaves, cat_info, cand_catmask) -> Tree:
+    """Unpack the packed node table into the public Tree struct."""
+    K = _PK
+    is_leaf = P[:, K.IS_LEAF] > 0.5
+    left = P[:, K.LEFT].astype(jnp.int32)
+    internal = (~is_leaf) & (left >= 0)
+    return Tree(
+        split_feature=P[:, K.SPLIT_FEAT].astype(jnp.int32),
+        split_bin=P[:, K.SPLIT_BIN].astype(jnp.int32),
+        left=left,
+        right=P[:, K.RIGHT].astype(jnp.int32),
+        leaf_value=P[:, K.LEAF_VALUE],
+        is_leaf=is_leaf,
+        count=P[:, K.COUNT],
+        split_gain=P[:, K.SPLIT_GAIN],
+        num_leaves=n_leaves,
+        is_cat_split=(None if cat_info is None
+                      else internal & (P[:, K.CAND_CAT] > 0.5)),
+        cat_mask=(None if cat_info is None else cand_catmask),
+    )
+
+
 def _rand_bins_for_node(key, node_id, num_features, num_bins, col_bins):
     """ExtraTrees: one random threshold position per feature per node
     (upstream ``extra_trees``), drawn WITHIN each feature's own used-bin
@@ -425,31 +478,9 @@ def grow_tree(
         root_best = _fp_reduce_best(root_best, fp_axis, num_features)
 
     K = _PK
-    nodes0 = jnp.zeros((capacity, K.NC), jnp.float32)
-    nodes0 = nodes0.at[:, K.SPLIT_FEAT].set(-1.0)
-    nodes0 = nodes0.at[:, K.LEFT].set(-1.0)
-    nodes0 = nodes0.at[:, K.RIGHT].set(-1.0)
-    nodes0 = nodes0.at[:, K.CAND_GAIN].set(neg_inf)
-    nodes0 = nodes0.at[:, K.BOUND_LO].set(-jnp.inf)
-    nodes0 = nodes0.at[:, K.BOUND_HI].set(jnp.inf)
-    root_row = jnp.zeros((K.NC,), jnp.float32)
-    root_row = root_row.at[jnp.array([
-        K.SPLIT_FEAT, K.LEFT, K.RIGHT, K.LEAF_VALUE, K.IS_LEAF, K.COUNT,
-        K.CAND_GAIN, K.CAND_FEAT, K.CAND_BIN, K.CAND_LG, K.CAND_LH,
-        K.CAND_LC, K.CAND_RG, K.CAND_RH, K.CAND_RC, K.CAND_WL, K.CAND_WR,
-        K.BOUND_LO, K.BOUND_HI, K.CAND_CAT])].set(jnp.stack([
-            jnp.float32(-1.0), jnp.float32(-1.0), jnp.float32(-1.0),
-            root_out, jnp.float32(1.0), root_tot[2],
-            root_best.gain, root_best.feature.astype(jnp.float32),
-            root_best.bin.astype(jnp.float32), root_best.left_g,
-            root_best.left_h, root_best.left_c, root_best.right_g,
-            root_best.right_h, root_best.right_c, root_best.left_out,
-            root_best.right_out, jnp.float32(-jnp.inf),
-            jnp.float32(jnp.inf),
-            (root_best.cat.astype(jnp.float32) if cat_info is not None
-             else jnp.float32(0.0))]))
     st = _GrowState(
-        nodes=nodes0.at[0].set(root_row),
+        nodes=_packed_root_table(capacity, root_out, root_tot, root_best,
+                                 cat_info),
         row_leaf=jnp.zeros(n, jnp.int32),
         n_nodes=jnp.int32(1),
         n_leaves=jnp.int32(1),
@@ -588,25 +619,8 @@ def grow_tree(
         )
 
     st = lax.fori_loop(0, num_leaves - 1, body, st)
-
-    P = st.nodes
-    is_leaf = P[:, K.IS_LEAF] > 0.5
-    left = P[:, K.LEFT].astype(jnp.int32)
-    internal = (~is_leaf) & (left >= 0)
-    tree = Tree(
-        split_feature=P[:, K.SPLIT_FEAT].astype(jnp.int32),
-        split_bin=P[:, K.SPLIT_BIN].astype(jnp.int32),
-        left=left,
-        right=P[:, K.RIGHT].astype(jnp.int32),
-        leaf_value=P[:, K.LEAF_VALUE],
-        is_leaf=is_leaf,
-        count=P[:, K.COUNT],
-        split_gain=P[:, K.SPLIT_GAIN],
-        num_leaves=st.n_leaves,
-        is_cat_split=(None if cat_info is None
-                      else internal & (P[:, K.CAND_CAT] > 0.5)),
-        cat_mask=(None if cat_info is None else st.cand_catmask),
-    )
+    tree = _tree_from_packed(st.nodes, st.n_leaves, cat_info,
+                             st.cand_catmask)
     return tree, st.row_leaf
 
 
@@ -622,31 +636,7 @@ def _scatter(arr, idx, val, active):
 
 
 class _WaveState(NamedTuple):
-    # tree under construction (same layout as _GrowState)
-    split_feature: jnp.ndarray
-    split_bin: jnp.ndarray
-    left: jnp.ndarray
-    right: jnp.ndarray
-    leaf_value: jnp.ndarray
-    is_leaf: jnp.ndarray
-    count: jnp.ndarray
-    split_gain: jnp.ndarray
-    depth: jnp.ndarray
-    # cached best candidate split per created node
-    cand_gain: jnp.ndarray
-    cand_feat: jnp.ndarray
-    cand_bin: jnp.ndarray
-    cand_lg: jnp.ndarray
-    cand_lh: jnp.ndarray
-    cand_lc: jnp.ndarray
-    cand_rg: jnp.ndarray
-    cand_rh: jnp.ndarray
-    cand_rc: jnp.ndarray
-    # constrained child outputs + monotone ancestor bounds per node
-    cand_wl: jnp.ndarray
-    cand_wr: jnp.ndarray
-    bound_lo: jnp.ndarray
-    bound_hi: jnp.ndarray
+    nodes: jnp.ndarray          # f32[M, _PK.NC] packed per-node table
     # frontier extras
     hist_cache: jnp.ndarray     # f32[num_leaves, F, B, 3] per-active-leaf
     node_slot: jnp.ndarray      # i32[M] node id -> hist_cache slot
@@ -654,8 +644,7 @@ class _WaveState(NamedTuple):
     row_leaf: jnp.ndarray
     n_nodes: jnp.ndarray
     n_leaves: jnp.ndarray
-    # categorical candidate splits (None when the dataset has none)
-    cand_cat: Optional[jnp.ndarray] = None      # bool[M]
+    # categorical candidate split masks (None when the dataset has none)
     cand_catmask: Optional[jnp.ndarray] = None  # bool[M, B]
     # interaction constraints: surviving group set per node (None = off)
     ic_sets: Optional[jnp.ndarray] = None       # bool[M, NG]
@@ -764,37 +753,16 @@ def grow_tree_frontier(
     def full(val, dtype):
         return jnp.full((capacity,), val, dtype)
 
+    K = _PK
     st = _WaveState(
-        split_feature=full(-1, jnp.int32),
-        split_bin=full(0, jnp.int32),
-        left=full(-1, jnp.int32),
-        right=full(-1, jnp.int32),
-        leaf_value=full(0.0, jnp.float32).at[0].set(root_out),
-        is_leaf=full(False, jnp.bool_).at[0].set(True),
-        count=full(0.0, jnp.float32).at[0].set(root_tot[2]),
-        split_gain=full(0.0, jnp.float32),
-        depth=full(0, jnp.int32),
-        cand_gain=full(neg_inf, jnp.float32).at[0].set(root_best.gain),
-        cand_feat=full(0, jnp.int32).at[0].set(root_best.feature),
-        cand_bin=full(0, jnp.int32).at[0].set(root_best.bin),
-        cand_lg=full(0.0, jnp.float32).at[0].set(root_best.left_g),
-        cand_lh=full(0.0, jnp.float32).at[0].set(root_best.left_h),
-        cand_lc=full(0.0, jnp.float32).at[0].set(root_best.left_c),
-        cand_rg=full(0.0, jnp.float32).at[0].set(root_best.right_g),
-        cand_rh=full(0.0, jnp.float32).at[0].set(root_best.right_h),
-        cand_rc=full(0.0, jnp.float32).at[0].set(root_best.right_c),
-        cand_wl=full(0.0, jnp.float32).at[0].set(root_best.left_out),
-        cand_wr=full(0.0, jnp.float32).at[0].set(root_best.right_out),
-        bound_lo=full(-jnp.inf, jnp.float32),
-        bound_hi=full(jnp.inf, jnp.float32),
+        nodes=_packed_root_table(capacity, root_out, root_tot, root_best,
+                                 cat_info),
         hist_cache=jnp.zeros((num_leaves, num_features, num_bins, 3),
                              jnp.float32).at[0].set(root_hist),
         node_slot=full(0, jnp.int32),
         row_leaf=jnp.zeros(n, jnp.int32),
         n_nodes=jnp.int32(1),
         n_leaves=jnp.int32(1),
-        cand_cat=(None if cat_info is None else
-                  full(False, jnp.bool_).at[0].set(root_best.cat)),
         cand_catmask=(None if cat_info is None else
                       jnp.zeros((capacity, num_bins), jnp.bool_)
                       .at[0].set(root_best.cat_mask)),
@@ -807,13 +775,15 @@ def grow_tree_frontier(
     iota_w = lax.iota(jnp.int32, w_width)
 
     def cond(st: _WaveState):
-        gains = jnp.where(st.is_leaf, st.cand_gain, neg_inf)
+        P = st.nodes
+        gains = jnp.where(P[:, K.IS_LEAF] > 0.5, P[:, K.CAND_GAIN], neg_inf)
         return (st.n_leaves < num_leaves) & jnp.any(jnp.isfinite(gains))
 
     def body(st: _WaveState) -> _WaveState:
         m = capacity
+        P = st.nodes
         # 1. rank active leaves by cached candidate gain (desc, stable).
-        gains = jnp.where(st.is_leaf, st.cand_gain, neg_inf)
+        gains = jnp.where(P[:, K.IS_LEAF] > 0.5, P[:, K.CAND_GAIN], neg_inf)
         order = jnp.argsort(-gains)                       # [M]
         rank = jnp.zeros(m, jnp.int32).at[order].set(
             lax.iota(jnp.int32, m))
@@ -846,18 +816,19 @@ def grow_tree_frontier(
         # kernel itself.
         parent_r = order[:w_width]                        # [W] node ids
         active_r = iota_w < s
-        direct_left = st.cand_lc[parent_r] <= st.cand_rc[parent_r]
-        nl_r = st.n_nodes + 2 * iota_w
+        prow = P[parent_r]            # [W, NC] — ONE gather for all the
+        direct_left = prow[:, K.CAND_LC] <= prow[:, K.CAND_RC]  # per-parent
+        nl_r = st.n_nodes + 2 * iota_w                          # scalars
         nr_r = nl_r + 1
         dl_of = _scatter(full(m, jnp.bool_), parent_r, direct_left,
                          active_r)                        # node -> direct side
         p = st.row_leaf
         f32 = jnp.float32
-        cols = [sel.astype(f32), st.cand_feat.astype(f32),
-                st.cand_bin.astype(f32), nl_of.astype(f32),
+        cols = [sel.astype(f32), P[:, K.CAND_FEAT],
+                P[:, K.CAND_BIN], nl_of.astype(f32),
                 nr_of.astype(f32), dl_of.astype(f32), rank.astype(f32)]
         if cat_info is not None:
-            cols.append(st.cand_cat.astype(f32))
+            cols.append(P[:, K.CAND_CAT])
         # DEFAULT precision (native-rate bf16 dot) is exact only while every
         # table value is an integer <= 256 (bf16 has an 8-bit significand);
         # feature ids beyond 256 or node ids beyond 256 (num_leaves >= 129)
@@ -911,18 +882,19 @@ def grow_tree_frontier(
         node_slot = _scatter(node_slot, nr_r, right_slot, active_r)
 
         # 5. child output bounds (monotone basic method, per splitting leaf).
-        pf = st.cand_feat[parent_r]
-        wl_w, wr_w = st.cand_wl[parent_r], st.cand_wr[parent_r]   # [W]
-        lo_w, hi_w = st.bound_lo[parent_r], st.bound_hi[parent_r]
+        pf = prow[:, K.CAND_FEAT].astype(jnp.int32)
+        wl_w, wr_w = prow[:, K.CAND_WL], prow[:, K.CAND_WR]       # [W]
+        lo_w, hi_w = prow[:, K.BOUND_LO], prow[:, K.BOUND_HI]
         lo_l, hi_l, lo_r, hi_r = _mono_child_bounds(mono, pf, wl_w, wr_w,
                                                     lo_w, hi_w)
 
         # 6. score candidates for all 2W fresh children from the cache.
         child_nodes = jnp.concatenate([nl_r, nr_r])       # [2W]
         child_hists = jnp.concatenate([left_hist, right_hist])
-        child_depth1 = st.depth[parent_r] + 1             # [W]
+        child_depth1 = prow[:, K.DEPTH] + 1.0             # [W]
         child_depth = jnp.concatenate([child_depth1, child_depth1])
-        depth_ok = (max_depth <= 0) | (child_depth < max_depth)
+        depth_ok = (max_depth <= 0) | \
+            (child_depth < max_depth.astype(jnp.float32))
         child_masks = jax.vmap(node_feature_mask)(child_nodes)
         if ic_member is not None:
             child_sets = (st.ic_sets[parent_r]
@@ -953,72 +925,64 @@ def grow_tree_frontier(
                                  child_lo, child_hi, child_vals)
         active_2 = jnp.concatenate([active_r, active_r])
 
-        # 7. commit: parents become internal, children become leaves.
-        pb = st.cand_bin[parent_r]
-        pg = gains[parent_r]
-        lc = st.cand_lc[parent_r]
-        rc = st.cand_rc[parent_r]
-        child_cnts = jnp.concatenate([lc, rc])
+        # 7. commit with TWO packed row scatters: the W split parents
+        # become internal (their rows keep every cached field and gain
+        # the split bookkeeping), the 2W fresh children arrive with
+        # their scored candidate splits.
+        parent_rows = prow.at[:, jnp.array([
+            K.SPLIT_FEAT, K.SPLIT_BIN, K.LEFT, K.RIGHT, K.IS_LEAF,
+            K.SPLIT_GAIN])].set(jnp.stack([
+                prow[:, K.CAND_FEAT], prow[:, K.CAND_BIN],
+                nl_r.astype(jnp.float32), nr_r.astype(jnp.float32),
+                jnp.zeros(w_width), gains[parent_r]], axis=-1))
+        child_rows = jnp.stack([
+            jnp.full((2 * w_width,), -1.0),              # SPLIT_FEAT
+            jnp.zeros((2 * w_width,)),                   # SPLIT_BIN
+            jnp.full((2 * w_width,), -1.0),              # LEFT
+            jnp.full((2 * w_width,), -1.0),              # RIGHT
+            child_vals,                                  # LEAF_VALUE
+            jnp.ones((2 * w_width,)),                    # IS_LEAF
+            jnp.concatenate([prow[:, K.CAND_LC],
+                             prow[:, K.CAND_RC]]),       # COUNT
+            jnp.zeros((2 * w_width,)),                   # SPLIT_GAIN
+            child_depth,                                 # DEPTH
+            bs.gain,                                     # CAND_GAIN
+            bs.feature.astype(jnp.float32),              # CAND_FEAT
+            bs.bin.astype(jnp.float32),                  # CAND_BIN
+            bs.left_g, bs.left_h, bs.left_c,
+            bs.right_g, bs.right_h, bs.right_c,
+            bs.left_out,                                 # CAND_WL
+            bs.right_out,                                # CAND_WR
+            child_lo,                                    # BOUND_LO
+            child_hi,                                    # BOUND_HI
+            (bs.cat.astype(jnp.float32) if cat_info is not None
+             else jnp.zeros((2 * w_width,))),            # CAND_CAT
+        ], axis=-1)                                      # [2W, NC]
+        oob = jnp.int32(capacity)
+        P2 = P.at[jnp.where(active_r, parent_r, oob)].set(
+            parent_rows, mode="drop")
+        kid_idx = jnp.where(active_2, child_nodes, oob)
+        P2 = P2.at[kid_idx].set(child_rows, mode="drop")
 
         return st._replace(
-            split_feature=_scatter(st.split_feature, parent_r, pf, active_r),
-            split_bin=_scatter(st.split_bin, parent_r, pb, active_r),
-            left=_scatter(st.left, parent_r, nl_r, active_r),
-            right=_scatter(st.right, parent_r, nr_r, active_r),
-            split_gain=_scatter(st.split_gain, parent_r, pg, active_r),
-            is_leaf=_scatter(
-                _scatter(st.is_leaf, parent_r,
-                         jnp.zeros(w_width, jnp.bool_), active_r),
-                child_nodes, jnp.ones(2 * w_width, jnp.bool_), active_2),
-            leaf_value=_scatter(st.leaf_value, child_nodes, child_vals,
-                                active_2),
-            count=_scatter(st.count, child_nodes, child_cnts, active_2),
-            depth=_scatter(st.depth, child_nodes, child_depth, active_2),
-            cand_gain=_scatter(st.cand_gain, child_nodes, bs.gain, active_2),
-            cand_feat=_scatter(st.cand_feat, child_nodes, bs.feature,
-                               active_2),
-            cand_bin=_scatter(st.cand_bin, child_nodes, bs.bin, active_2),
-            cand_lg=_scatter(st.cand_lg, child_nodes, bs.left_g, active_2),
-            cand_lh=_scatter(st.cand_lh, child_nodes, bs.left_h, active_2),
-            cand_lc=_scatter(st.cand_lc, child_nodes, bs.left_c, active_2),
-            cand_rg=_scatter(st.cand_rg, child_nodes, bs.right_g, active_2),
-            cand_rh=_scatter(st.cand_rh, child_nodes, bs.right_h, active_2),
-            cand_rc=_scatter(st.cand_rc, child_nodes, bs.right_c, active_2),
-            cand_wl=_scatter(st.cand_wl, child_nodes, bs.left_out, active_2),
-            cand_wr=_scatter(st.cand_wr, child_nodes, bs.right_out, active_2),
-            bound_lo=_scatter(st.bound_lo, child_nodes, child_lo, active_2),
-            bound_hi=_scatter(st.bound_hi, child_nodes, child_hi, active_2),
+            nodes=P2,
             hist_cache=cache,
             node_slot=node_slot,
             row_leaf=row_leaf,
             n_nodes=st.n_nodes + 2 * s,
             n_leaves=st.n_leaves + s,
-            cand_cat=(None if cat_info is None else _scatter(
-                st.cand_cat, child_nodes, bs.cat, active_2)),
-            cand_catmask=(None if cat_info is None else _scatter(
-                st.cand_catmask, child_nodes, bs.cat_mask, active_2)),
-            ic_sets=(None if ic_member is None else _scatter(
-                st.ic_sets, child_nodes,
-                jnp.concatenate([child_sets, child_sets]), active_2)),
+            cand_catmask=(None if cat_info is None else
+                          st.cand_catmask.at[kid_idx].set(
+                              bs.cat_mask, mode="drop")),
+            ic_sets=(None if ic_member is None else
+                     st.ic_sets.at[kid_idx].set(
+                         jnp.concatenate([child_sets, child_sets]),
+                         mode="drop")),
         )
 
     st = lax.while_loop(cond, body, st)
-
-    internal = (~st.is_leaf) & (st.left >= 0)
-    tree = Tree(
-        split_feature=st.split_feature,
-        split_bin=st.split_bin,
-        left=st.left,
-        right=st.right,
-        leaf_value=st.leaf_value,
-        is_leaf=st.is_leaf,
-        count=st.count,
-        split_gain=st.split_gain,
-        num_leaves=st.n_leaves,
-        is_cat_split=(None if cat_info is None
-                      else internal & st.cand_cat),
-        cat_mask=(None if cat_info is None else st.cand_catmask),
-    )
+    tree = _tree_from_packed(st.nodes, st.n_leaves, cat_info,
+                             st.cand_catmask)
     return tree, st.row_leaf
 
 
