@@ -262,15 +262,20 @@ def _vmem_blocking(num_features: int, num_bins: int, k: int,
         f_blk = max(8, f_blk // 8 * 8)
     n_fblk = -(-num_features // f_blk)
     f_pad = n_fblk * f_blk - num_features
-    # per-chunk tiles (one-hot B*chunk*2, folded stats chunk*K*2 x 2
-    # passes + f32 spread temporaries, bins chunk*F_blk*4, masks) with
-    # input double-buffering; the per-row estimate is deliberately fat —
-    # a too-small chunk costs a few % of MXU efficiency, a too-big one
-    # fails compile
+    # per-chunk tiles (one-hot B*chunk*2, folded stats chunk*K*2 + f32
+    # spread temporary chunk*K*4, bins chunk*F_blk*4 staged, masks) with
+    # input double-buffering.  The r3 estimate (4B + 20k + 8f + 64) was
+    # ~2x too fat: it drove the MSLR-shape chunk to 1536 and the pass to
+    # 61-64% of the bf16 FLOP model, where a measured chunk sweep peaks
+    # at ~4096 (75%; flat beyond).  The trimmed estimate plus the raised
+    # 4096 cap lands within ~3% of the measured optimum at the Higgs,
+    # MSLR, and Criteo-root shapes (chunk-sweep table in PERF.md, "r4
+    # session 2 kernel chunk sweep"); still conservative enough that no
+    # shape re-approaches the 16 MB scope.
     out_bytes = f_blk * num_bins * k_pad * 4
     budget = 11 * 1024 * 1024 - out_bytes
-    per_row = 4 * num_bins + 20 * k + 8 * f_blk + 64
-    chunk = max(chunk_align, min(2048, budget // max(per_row, 1)))
+    per_row = 2 * num_bins + 10 * k + 8 * f_blk + 128
+    chunk = max(chunk_align, min(4096, budget // max(per_row, 1)))
     chunk = int(chunk) // chunk_align * chunk_align or chunk_align
     return f_blk, n_fblk, f_pad, chunk
 
