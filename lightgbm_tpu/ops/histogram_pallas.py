@@ -310,10 +310,6 @@ def hist_fused_pallas(
         num_features, num_bins, k, chunk_align=512)
     if chunk is None:
         chunk = auto_chunk
-        if hist_dtype == "int8":
-            # Mosaic widens int8 intermediates aggressively (measured 43 MB
-            # of scoped VMEM at chunk=2048 vs ~14 MB for the bf16 path)
-            chunk = 512
     # transposed [F, n] i32 layout: the kernel's per-feature dynamic slice
     # must be on the MAJOR dim.  This is loop-invariant across the grower's
     # waves, so XLA hoists the transpose out of the growth while_loop.
