@@ -299,7 +299,9 @@ _FRAMEWORK_KEYS = {
     "fused_segment_rounds",  # update_many rounds per device dispatch
     "fobj",                # custom objective callable
     "wave_width",          # frontier grower: max splits per histogram pass
-    "wave_tail",           # "half" (near-strict tail) | "greedy" (fewest passes)
+    "wave_tail",           # "exact" (strict order via overgrow+replay) |
+                           # "greedy" (fewest passes) | "half" (near-strict)
+    "wave_overgrow",       # exact tail: overgrowth factor (default 1.5)
     "linear_k",            # linear_tree: max path features per leaf model
 }
 

@@ -109,6 +109,32 @@ def resolve_hist_dtype(p: Params, n_rows: int) -> str:
     return "bf16" if n_rows >= (1 << 19) else "f32"
 
 
+def _exact_overgrow_target(num_leaves: int, width: int, over: float) -> int:
+    """Wave-aligned overgrowth target for the exact tail.
+
+    Every histogram pass costs the same whether it retires 2 or ``width``
+    splits, so an overgrowth target that lands mid-wave buys its last few
+    candidate nodes at the price of a full pass.  Walk the greedy wave
+    schedule (same recurrence as the grower: wave size = min(frontier
+    doubling, width)) and pick the wave boundary closest to
+    ``num_leaves * over`` in log space, bounded to (num_leaves, 2.5x].
+    """
+    import math
+
+    target = max(num_leaves * over, num_leaves + 1)
+    leaves, cand = 1, 1
+    best = None
+    while leaves < 2.5 * num_leaves:
+        s = min(cand, width)
+        leaves += s
+        cand = min(cand * 2, leaves)
+        if leaves > num_leaves:
+            if best is None or (abs(math.log(leaves / target))
+                                < abs(math.log(best / target))):
+                best = leaves
+    return best or int(math.ceil(target))
+
+
 def resolve_wave_width(p: Params, n_rows: int) -> int:
     """Pick the grower's splits-per-histogram-pass (static).
 
@@ -128,26 +154,46 @@ def resolve_wave_width(p: Params, n_rows: int) -> int:
     if p.grow_policy == "leafwise":
         return 1
     width = int(p.extra.get("wave_width", 0)) or min(42, p.num_leaves - 1)
-    width = max(1, width)
-    # wave_tail: "half" (near-strict tail ordering) or "greedy" (whole
-    # remaining budget per wave — fewest histogram passes).  Default:
-    # greedy for large data (the documented fast default) and for
-    # mid-size POINTWISE tasks whose leaf budget is far from saturating
-    # the rows — r4 measured the diamonds shape (46k rows, nl=31,
-    # ~1.5k rows/leaf) quality-NEUTRAL across half/greedy/strict (test
-    # RMSE 0.0904/0.0903/0.0905) while greedy is 1.44x faster.  Half
-    # stays the default when the budget nearly saturates the data
-    # (7% RMSE on a 2k-row task) and for RANKING objectives at any size
-    # (rank lambdas are tail-order-sensitive: greedy cost ~6e-2 NDCG@10
-    # on the MSLR bench).  Encoded in the sign of the static width
-    # (models/tree.py grow_tree).
+    # clamp below the exact-mode encoding base (1024): an unclamped user
+    # width would collide with the overgrow_leaves*1024 encoding and
+    # silently misroute the grower (code review r5); >512 lanes is far
+    # past the MXU tile sweet spot anyway
+    width = max(1, min(width, 512))
+    # wave_tail — how the wave schedule spends the tail of the leaf
+    # budget, where wave and strict best-first order can diverge:
+    #   "exact"  — overgrow greedily ~1.5x past num_leaves, then replay
+    #     strict best-first selection over the realized gains and prune
+    #     (models/tree.py _exact_prune).  LightGBM-exact split ORDER at
+    #     ~one extra histogram pass over greedy; r4's gap decomposition
+    #     proved split order was the ENTIRE residual quality gap of the
+    #     old near-strict tail (PERF.md), so this is the default
+    #     wherever order can matter: large data (the AUC-parity north
+    #     star), budget-saturating small data, and every ranking
+    #     objective (rank lambdas are tail-order-sensitive: the greedy
+    #     tail costs ~6e-2 NDCG@10 on the MSLR bench).
+    #   "greedy" — whole remaining budget per wave, fewest passes.
+    #     Default only for mid-size pointwise tasks whose budget is far
+    #     from saturating the rows — r4 measured the diamonds shape
+    #     (46k rows, nl=31, ~1.5k rows/leaf) quality-NEUTRAL across
+    #     half/greedy/strict while greedy is 1.44x faster.
+    #   "half"   — at most half the remaining budget per wave
+    #     (near-strict tail, r3's compromise; kept for compatibility).
+    # Encoding (static width int, rides all existing plumbing): negative
+    # = greedy; >= 1024 = exact (overgrow_leaves * 1024 + width).
     rows_per_leaf = n_rows // max(p.num_leaves, 1)
-    pointwise = p.objective not in ("lambdarank", "rank_xendcg")
-    default_tail = ("greedy" if pointwise and (n_rows >= (1 << 19)
-                                               or rows_per_leaf >= 1024)
-                    else "half")
-    if str(p.extra.get("wave_tail", default_tail)) == "greedy":
+    # objective "none" = user-supplied fobj whose tail-order sensitivity
+    # is unknown (a custom ranking loss would silently eat the greedy
+    # tail's ~6e-2 NDCG cost) — classify it conservatively (ADVICE r4)
+    pointwise = p.objective not in ("lambdarank", "rank_xendcg", "none")
+    default_tail = ("greedy" if pointwise and rows_per_leaf >= 1024
+                    and n_rows < (1 << 19) else "exact")
+    tail = str(p.extra.get("wave_tail", default_tail))
+    if tail == "greedy":
         width = -width
+    elif tail == "exact":
+        over = float(p.extra.get("wave_overgrow", 1.5))
+        l_over = _exact_overgrow_target(p.num_leaves, width, over)
+        width = l_over * 1024 + width
     if p.grow_policy == "frontier":
         return width
     return width if (n_rows >= 4096 and p.num_leaves >= 16) else 1
@@ -1235,7 +1281,7 @@ class Booster:
                 p.extra.get("hist_impl", "auto"),
                 int(p.extra.get("row_chunk", 131072)), p.boosting == "rf",
                 resolve_hist_dtype(p, eff_rows), self._num_class,
-                self._cat_key)
+                self._cat_key, resolve_wave_width(p, eff_rows))
             pad_cols = self._fp_width - int(fmask.shape[0])
             fmask_p = jnp.concatenate(
                 [fmask, jnp.zeros(pad_cols, jnp.float32)]) \

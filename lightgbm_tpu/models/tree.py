@@ -110,7 +110,8 @@ class _PK:
     BOUND_LO = 20     # init -inf
     BOUND_HI = 21     # init +inf
     CAND_CAT = 22     # 0/1 (unused when the dataset has no categoricals)
-    NC = 23
+    PM = 23           # pathmin: min candidate gain over ancestors-or-self
+    NC = 24           # (set at creation; drives exact-tail selection)
 
 
 class _GrowState(NamedTuple):
@@ -125,15 +126,29 @@ class _GrowState(NamedTuple):
     ic_sets: Optional[jnp.ndarray] = None       # bool[M, NG]
 
 
+def decode_wave_width(wave_width: int):
+    """Decode the static wave-width int into (width, tail, overgrow_leaves).
+
+    SINGLE SOURCE for the encoding produced by ``gbdt.resolve_wave_width``
+    (negative = greedy tail; >= 1024 = exact tail, ``overgrow_leaves *
+    1024 + width``; else half) — the grower, the profiling report, and
+    the bench FLOP model all decode through here.
+    """
+    if wave_width < 0:
+        return -wave_width, "greedy", None
+    if wave_width >= 1024:
+        return wave_width % 1024, "exact", wave_width // 1024
+    return wave_width, "half", None
+
+
 def _write(arr, idx, val, active):
     """Masked scalar write arr[idx] = val if active."""
     return arr.at[idx].set(jnp.where(active, val, arr[idx]))
 
 
-def _packed_root_table(capacity, root_out, root_tot, root_best,
-                       cat_info) -> jnp.ndarray:
-    """Initial packed [capacity, _PK.NC] node table with the root's row set
-    (shared by the strict and frontier growers)."""
+def _empty_packed_table(capacity: int) -> jnp.ndarray:
+    """All-sentinel packed [capacity, _PK.NC] node table (unused slots:
+    no children, no candidate, unbounded)."""
     K = _PK
     nodes0 = jnp.zeros((capacity, K.NC), jnp.float32)
     nodes0 = nodes0.at[:, K.SPLIT_FEAT].set(-1.0)
@@ -142,12 +157,22 @@ def _packed_root_table(capacity, root_out, root_tot, root_best,
     nodes0 = nodes0.at[:, K.CAND_GAIN].set(-jnp.inf)
     nodes0 = nodes0.at[:, K.BOUND_LO].set(-jnp.inf)
     nodes0 = nodes0.at[:, K.BOUND_HI].set(jnp.inf)
+    nodes0 = nodes0.at[:, K.PM].set(-jnp.inf)
+    return nodes0
+
+
+def _packed_root_table(capacity, root_out, root_tot, root_best,
+                       cat_info) -> jnp.ndarray:
+    """Initial packed [capacity, _PK.NC] node table with the root's row set
+    (shared by the strict and frontier growers)."""
+    K = _PK
+    nodes0 = _empty_packed_table(capacity)
     root_row = jnp.zeros((K.NC,), jnp.float32)
     root_row = root_row.at[jnp.array([
         K.SPLIT_FEAT, K.LEFT, K.RIGHT, K.LEAF_VALUE, K.IS_LEAF, K.COUNT,
         K.CAND_GAIN, K.CAND_FEAT, K.CAND_BIN, K.CAND_LG, K.CAND_LH,
         K.CAND_LC, K.CAND_RG, K.CAND_RH, K.CAND_RC, K.CAND_WL, K.CAND_WR,
-        K.BOUND_LO, K.BOUND_HI, K.CAND_CAT])].set(jnp.stack([
+        K.BOUND_LO, K.BOUND_HI, K.CAND_CAT, K.PM])].set(jnp.stack([
             jnp.float32(-1.0), jnp.float32(-1.0), jnp.float32(-1.0),
             root_out, jnp.float32(1.0), root_tot[2],
             root_best.gain, root_best.feature.astype(jnp.float32),
@@ -157,7 +182,8 @@ def _packed_root_table(capacity, root_out, root_tot, root_best,
             root_best.right_out, jnp.float32(-jnp.inf),
             jnp.float32(jnp.inf),
             (root_best.cat.astype(jnp.float32) if cat_info is not None
-             else jnp.float32(0.0))]))
+             else jnp.float32(0.0)),
+            root_best.gain]))
     return nodes0.at[0].set(root_row)
 
 
@@ -399,22 +425,32 @@ def grow_tree(
 
     ``|wave_width| > 1`` dispatches to :func:`grow_tree_frontier` (multiple
     splits per histogram pass via the subtraction trick — the large-data
-    fast path).  A NEGATIVE ``wave_width`` selects the "greedy" wave tail
-    (spend the whole remaining leaf budget per wave — fewest histogram
-    passes); positive keeps the "half" tail (near-strict tail ordering).
-    The sign encoding lets the policy ride every existing static plumbing
-    path (compile-cache keys, mesh learners) untouched.
+    fast path).  ``wave_width`` carries the wave TAIL policy in its
+    encoding so the policy rides every existing static plumbing path
+    (compile-cache keys, mesh learners) untouched:
+
+      * NEGATIVE — "greedy" tail (spend the whole remaining leaf budget
+        per wave, fewest histogram passes);
+      * ``>= 1024`` — "exact" mode, encoded ``overgrow_leaves * 1024 +
+        width``: overgrow greedily to ``overgrow_leaves``, then replay
+        strict best-first selection over the realized gains and prune
+        back to ``num_leaves`` (LightGBM-exact split ORDER at near-greedy
+        pass counts — see :func:`_exact_prune`);
+      * otherwise — "half" tail (near-strict tail ordering).
     """
-    if wave_width < 0:
-        wave_width, wave_tail = -wave_width, "greedy"
-    if wave_width > 1 and fp_axis is None:
-        # (the frontier grower runs data-parallel but not feature-parallel)
+    wave_width, decoded_tail, overgrow_leaves = decode_wave_width(wave_width)
+    if decoded_tail != "half" or wave_tail == "half":
+        wave_tail = decoded_tail
+    if wave_width > 1 and not (fp_axis is not None and cat_info is not None):
+        # (frontier + feature-parallel since r5; categorical k-vs-rest
+        # splits under fp keep the strict grower's psum-broadcast path)
         return grow_tree_frontier(
             bins, stats, feature_mask, ctx, num_leaves, num_bins, max_depth,
             wave_width, ff_bynode=ff_bynode, key=key, axis_name=axis_name,
             hist_impl=hist_impl, row_chunk=row_chunk, hist_dtype=hist_dtype,
             cat_info=cat_info, mono=mono, extra_trees=extra_trees,
-            col_bins=col_bins, ic_member=ic_member, wave_tail=wave_tail)
+            col_bins=col_bins, ic_member=ic_member, wave_tail=wave_tail,
+            overgrow_leaves=overgrow_leaves, fp_axis=fp_axis)
     n, num_features = bins.shape
     capacity = 2 * num_leaves - 1
     max_depth = jnp.asarray(max_depth, jnp.int32)
@@ -598,6 +634,7 @@ def grow_tree(
             two(hi_l, hi_r),                             # BOUND_HI
             (bs.cat.astype(jnp.float32) if cat_info is not None
              else jnp.zeros((2,))),                      # CAND_CAT
+            jnp.minimum(row[K.PM], bs.gain),             # PM
         ], axis=-1)                                      # [2, NC]
         oob = jnp.int32(capacity)
         P = P.at[jnp.where(active, leaf, oob)].set(leaf_row, mode="drop")
@@ -633,6 +670,124 @@ def _scatter(arr, idx, val, active):
     oob = arr.shape[0]
     safe = jnp.where(active, idx, oob)
     return arr.at[safe].set(val, mode="drop")
+
+
+def _exact_prune(P, cand_catmask, row_leaf, num_leaves: int,
+                 cat_info):
+    """Replay strict best-first selection over an OVERGROWN wave tree and
+    prune it back to ``num_leaves`` — LightGBM-exact split order at wave
+    cost.
+
+    Every node's candidate split (gain, feature, bin, child outputs)
+    depends only on its OWN rows, so the overgrown tree's realized gains
+    are exactly the gains strict growth would have scored, and strict
+    best-first growth is priority-first extraction over that gain tree
+    (a node becomes extractable when its parent is extracted).  The
+    selection below replays the extraction literally on the packed node
+    table; the pruning and row remap are vectorized.
+
+    Coverage caveat: if strict would have split a node the overgrowth
+    never expanded (an overgrown LEAF with competitive gain), that node
+    stays a leaf and its budget goes to the next-best candidate — the
+    only divergence from true strict order.  The overgrowth waves
+    select by PATHMIN (= priority-first extraction order between
+    distinct priorities), which expands nodes in near-strict order and
+    makes misses rare at ~1.5x overgrowth (validated against the strict
+    grower in tests/test_exact_wave.py; quality impact measured in the
+    bench's parity section).
+
+    Returns (packed table [2*num_leaves-1, NC], pruned cand_catmask,
+    remapped row_leaf, n_leaves).
+    """
+    K = _PK
+    m_over = P.shape[0]
+    capacity = 2 * num_leaves - 1
+    ids = lax.iota(jnp.int32, m_over)
+    left = P[:, K.LEFT].astype(jnp.int32)
+    right = P[:, K.RIGHT].astype(jnp.int32)
+    # parent pointers (root: parent = self = 0)
+    parent = jnp.zeros(m_over, jnp.int32)
+    parent = _scatter(parent, left, ids, left >= 0)
+    parent = _scatter(parent, right, ids, right >= 0)
+
+    expandable = left >= 0            # children exist in the overgrown tree
+    # Sequential priority-first replay of strict extraction.  A single
+    # (pathmin desc, id asc) sort selects the right SET between distinct
+    # pathmin values, but inside a pathmin TIE GROUP (structural: every
+    # chain capped by one weak ancestor shares its pm) strict extraction
+    # dives into high-gain descendants while any static id order is
+    # breadth-first — and the budget boundary lands exactly in the
+    # low-gain region where those groups are widest.  So the selection
+    # replays extraction literally: num_leaves-1 trips of (argmax over
+    # available candidate gains -> keep -> activate children), all on
+    # [m_over]-sized arrays (~6 tiny fused kernels per trip; a few ms per
+    # round at production shapes).  Overgrown leaves with no scored
+    # children (coverage misses — rare under pathmin-ordered overgrowth)
+    # are skipped in favor of the next-best candidate.
+    gain_c = P[:, K.CAND_GAIN]
+    avail0 = jnp.zeros(m_over, bool).at[0].set(True)
+    kept0 = jnp.zeros(m_over, bool)
+
+    def extract(_, carry):
+        avail, kept = carry
+        g_av = jnp.where(avail & expandable, gain_c, -jnp.inf)
+        i = jnp.argmax(g_av).astype(jnp.int32)
+        ok = jnp.isfinite(g_av[i])
+        oob = jnp.int32(m_over)
+        kept = kept.at[jnp.where(ok, i, oob)].set(True, mode="drop")
+        avail = avail.at[jnp.where(ok, i, oob)].set(False, mode="drop")
+        kids = jnp.where(ok, jnp.stack([left[i], right[i]]), oob)
+        avail = avail.at[kids].set(True, mode="drop")
+        return avail, kept
+
+    _, kept = lax.fori_loop(0, num_leaves - 1, extract, (avail0, kept0))
+    n_kept = jnp.sum(kept.astype(jnp.int32))
+
+    # final leaves = children of kept splits that are not themselves kept
+    # (plus the root when nothing was kept at all).  Gate on REAL nodes:
+    # when growth stalls below the overgrowth target, unused table slots
+    # keep parent=0, and once the root is kept they would masquerade as
+    # its children — ghost IS_LEAF rows in the output (code review r5).
+    real = (P[:, K.IS_LEAF] > 0.5) | expandable
+    final_leaf = real & (~kept) & ((kept[parent] & (ids != 0))
+                                   | ((ids == 0) & (n_kept == 0)))
+    surv = kept | final_leaf
+    newid = jnp.cumsum(surv.astype(jnp.int32)) - 1
+
+    # rewrite rows: kept nodes stay internal with remapped children; final
+    # leaves revert to leaf sentinels (their LEAF_VALUE / COUNT were set at
+    # creation from the parent's candidate — identical to strict growth)
+    f32 = jnp.float32
+    P_mod = P
+    P_mod = P_mod.at[:, K.LEFT].set(
+        jnp.where(kept, newid[jnp.maximum(left, 0)], -1).astype(f32))
+    P_mod = P_mod.at[:, K.RIGHT].set(
+        jnp.where(kept, newid[jnp.maximum(right, 0)], -1).astype(f32))
+    P_mod = P_mod.at[:, K.IS_LEAF].set(jnp.where(kept, 0.0, 1.0))
+    P_mod = P_mod.at[:, K.SPLIT_FEAT].set(
+        jnp.where(kept, P[:, K.SPLIT_FEAT], -1.0))
+    P_mod = P_mod.at[:, K.SPLIT_BIN].set(
+        jnp.where(kept, P[:, K.SPLIT_BIN], 0.0))
+    P_mod = P_mod.at[:, K.SPLIT_GAIN].set(
+        jnp.where(kept, P[:, K.SPLIT_GAIN], 0.0))
+    target = jnp.where(surv, newid, capacity)
+    newP = _empty_packed_table(capacity).at[target].set(P_mod, mode="drop")
+    new_cat = (None if cat_info is None else
+               jnp.zeros((capacity, cand_catmask.shape[1]), jnp.bool_)
+               .at[target].set(cand_catmask, mode="drop"))
+
+    # rows point at overgrown leaves — map each to its unique final-leaf
+    # ancestor-or-self (pointer doubling: k squarings cover chains of
+    # 2^k nodes, and any ancestor chain is < m_over long), then newid
+    f = jnp.where(final_leaf, ids, parent)
+    for _ in range(max(4, int(m_over).bit_length())):
+        f = f[f]
+    node_to_new = jnp.where(final_leaf[f], newid[f], 0).astype(f32)
+    row_leaf_new = lookup_values(
+        row_leaf, node_to_new,
+        precision=(lax.Precision.DEFAULT if capacity <= 256
+                   else lax.Precision.HIGHEST)).astype(jnp.int32)
+    return newP, new_cat, row_leaf_new, n_kept + 1
 
 
 class _WaveState(NamedTuple):
@@ -671,6 +826,8 @@ def grow_tree_frontier(
     col_bins=None,
     ic_member=None,
     wave_tail: str = "half",
+    overgrow_leaves: Optional[int] = None,
+    fp_axis: Optional[str] = None,
 ) -> Tuple[Tree, jnp.ndarray]:
     """Best-first growth in WAVES: up to ``wave_width`` splits per data pass.
 
@@ -698,11 +855,19 @@ def grow_tree_frontier(
     budget on wave-start leaves that strict growth would have skipped in
     favor of higher-gain fresh children.  Predictive quality is equivalent
     in practice (tests compare both modes); LightGBM-exact split order
-    requires the strict grower.
+    needs either the strict grower or ``wave_tail="exact"`` — overgrow
+    greedily to ``overgrow_leaves``, then :func:`_exact_prune` replays
+    strict best-first selection over the realized gains and prunes back
+    to ``num_leaves`` (the budget-binding tail is the ONLY place wave and
+    strict order diverge, so recovering it recovers strict order at
+    roughly one extra histogram pass — PERF.md r4 gap decomposition).
     """
     n, num_features = bins.shape
-    capacity = 2 * num_leaves - 1
-    w_width = min(int(wave_width), num_leaves - 1)
+    exact = wave_tail == "exact"
+    grow_leaves = (max(num_leaves + 1, int(overgrow_leaves or 0))
+                   if exact else num_leaves)
+    capacity = 2 * grow_leaves - 1
+    w_width = min(int(wave_width), grow_leaves - 1)
     max_depth = jnp.asarray(max_depth, jnp.int32)
     neg_inf = jnp.float32(-jnp.inf)
     if key is None:
@@ -749,6 +914,12 @@ def grow_tree_frontier(
                                 jnp.bool_(True), cat_info, mono=mono,
                                 parent_out=root_out,
                                 rand_bins=node_rand_bins(0))
+    if fp_axis is not None:
+        # feature-parallel: each shard scanned its own column slice; one
+        # tiny all_gather + argmax globalizes the winner (the same split
+        # exchange the strict grower uses — upstream's
+        # FeatureParallelTreeLearner, SURVEY.md §2C)
+        root_best = _fp_reduce_best(root_best, fp_axis, num_features)
 
     def full(val, dtype):
         return jnp.full((capacity,), val, dtype)
@@ -757,7 +928,7 @@ def grow_tree_frontier(
     st = _WaveState(
         nodes=_packed_root_table(capacity, root_out, root_tot, root_best,
                                  cat_info),
-        hist_cache=jnp.zeros((num_leaves, num_features, num_bins, 3),
+        hist_cache=jnp.zeros((grow_leaves, num_features, num_bins, 3),
                              jnp.float32).at[0].set(root_hist),
         node_slot=full(0, jnp.int32),
         row_leaf=jnp.zeros(n, jnp.int32),
@@ -777,17 +948,25 @@ def grow_tree_frontier(
     def cond(st: _WaveState):
         P = st.nodes
         gains = jnp.where(P[:, K.IS_LEAF] > 0.5, P[:, K.CAND_GAIN], neg_inf)
-        return (st.n_leaves < num_leaves) & jnp.any(jnp.isfinite(gains))
+        return (st.n_leaves < grow_leaves) & jnp.any(jnp.isfinite(gains))
 
     def body(st: _WaveState) -> _WaveState:
         m = capacity
         P = st.nodes
         # 1. rank active leaves by cached candidate gain (desc, stable).
+        # Exact mode ranks by PATHMIN instead: priority-first extraction
+        # order on a tree IS descending pathmin (see _exact_prune), so
+        # pm-ordered waves expand nodes in the same order strict growth
+        # would — the overgrown tree then CONTAINS the strict selection
+        # (no coverage misses at the replay), instead of greedy-by-gain
+        # overgrowth hoping to have covered it.
         gains = jnp.where(P[:, K.IS_LEAF] > 0.5, P[:, K.CAND_GAIN], neg_inf)
-        order = jnp.argsort(-gains)                       # [M]
+        sel_key = (jnp.where(P[:, K.IS_LEAF] > 0.5, P[:, K.PM], neg_inf)
+                   if exact else gains)
+        order = jnp.argsort(-sel_key, stable=True)        # [M]
         rank = jnp.zeros(m, jnp.int32).at[order].set(
             lax.iota(jnp.int32, m))
-        budget = num_leaves - st.n_leaves
+        budget = grow_leaves - st.n_leaves
         n_cand = jnp.sum(jnp.isfinite(gains)).astype(jnp.int32)
         # Wave size: every histogram pass costs the same (the one-hot
         # matmul pads the segment lanes to a full MXU tile), so wave count
@@ -796,18 +975,16 @@ def grow_tree_frontier(
         # allocates the tail splits near-strict-best-first at ~5 extra
         # passes.  The tail refinement is what preserves strict-growth
         # quality when the leaf budget nearly saturates the data (small-n /
-        # large-num_leaves); ``wave_tail`` picks the tradeoff.
+        # large-num_leaves); ``wave_tail`` picks the tradeoff.  "exact"
+        # overgrows with the greedy schedule (the post-hoc replay, not the
+        # wave order, is what restores strict allocation).
         if wave_tail == "half":
             alloc = jnp.maximum(jnp.int32(1), budget // 2)
-        else:  # "greedy"
+        else:  # "greedy" / "exact"
             alloc = budget
         s = jnp.minimum(jnp.minimum(n_cand, alloc),
                         jnp.int32(w_width))               # splits this wave
         sel = jnp.isfinite(gains) & (rank < s)            # [M]
-
-        # children node ids, in node space (valid where sel)
-        nl_of = st.n_nodes + 2 * rank
-        nr_of = nl_of + 1
 
         # 2. partition rows of all splitting leaves at once.  Per-row state
         # comes from ONE one-hot-matmul table lookup (ops.lookup): XLA's
@@ -824,16 +1001,27 @@ def grow_tree_frontier(
                          active_r)                        # node -> direct side
         p = st.row_leaf
         f32 = jnp.float32
+        # child ids ride as WAVE-RELATIVE offsets (2*rank <= 2W <= 256),
+        # not absolute node ids: absolute ids exceed 256 whenever the
+        # (overgrown) capacity does, which would force the HIGHEST-
+        # precision dot below — at 11M rows that lookup is the wave's
+        # second-largest cost.  child = n_nodes + offset reconstructs the
+        # absolute id with a traced scalar add after the lookup.
         cols = [sel.astype(f32), P[:, K.CAND_FEAT],
-                P[:, K.CAND_BIN], nl_of.astype(f32),
-                nr_of.astype(f32), dl_of.astype(f32), rank.astype(f32)]
+                P[:, K.CAND_BIN], (2 * rank).astype(f32),
+                dl_of.astype(f32)]
         if cat_info is not None:
             cols.append(P[:, K.CAND_CAT])
         # DEFAULT precision (native-rate bf16 dot) is exact only while every
         # table value is an integer <= 256 (bf16 has an 8-bit significand);
-        # feature ids beyond 256 or node ids beyond 256 (num_leaves >= 129)
-        # need the full-precision dot or rows partition on corrupted ids
-        exact_in_bf16 = max(num_features, capacity, num_bins) <= 256
+        # feature ids beyond 256 need the full-precision dot or rows
+        # partition on corrupted ids.  (The one-hot INDEX side is exact at
+        # any capacity — only table VALUES are constrained.)  Under
+        # feature sharding the table carries GLOBAL feature ids whose
+        # range this shard cannot bound statically — always exact there.
+        exact_in_bf16 = (fp_axis is None
+                         and max(num_features, 2 * w_width,
+                                 num_bins) <= 256)
         pv = lookup_rows(p, jnp.stack(cols, axis=1),
                          precision=(lax.Precision.DEFAULT if exact_in_bf16
                                     else lax.Precision.HIGHEST))
@@ -841,9 +1029,21 @@ def grow_tree_frontier(
         feat_r = pv[:, 1].astype(jnp.int32)
         thr_r = pv[:, 2]
         # per-row split value WITHOUT take_along_axis (same gather problem):
-        # masked lane-reduction over the feature axis
-        fmatch = feat_r[:, None] == lax.iota(jnp.int32, num_features)[None, :]
-        v = jnp.sum(jnp.where(fmatch, bins_i32, 0), axis=1)
+        # masked lane-reduction over the feature axis.  Under feature
+        # sharding the ids are global: match against this shard's global
+        # column range and psum — the owning shard contributes the codes
+        # (the [n] bitmap exchange of upstream's feature-parallel split,
+        # batched over the whole wave)
+        if fp_axis is not None:
+            gids = (lax.axis_index(fp_axis) * num_features
+                    + lax.iota(jnp.int32, num_features))
+            fmatch = feat_r[:, None] == gids[None, :]
+            v = lax.psum(jnp.sum(jnp.where(fmatch, bins_i32, 0), axis=1),
+                         fp_axis)
+        else:
+            fmatch = (feat_r[:, None]
+                      == lax.iota(jnp.int32, num_features)[None, :])
+            v = jnp.sum(jnp.where(fmatch, bins_i32, 0), axis=1)
         if cat_info is None:
             go_left = v.astype(f32) <= thr_r
         else:
@@ -854,16 +1054,17 @@ def grow_tree_frontier(
             bit = jnp.sum(
                 jnp.where(v[:, None] == lax.iota(jnp.int32, num_bins)[None, :],
                           mrow, 0.0), axis=1)
-            go_left = jnp.where(pv[:, 7] > 0, bit > 0,
+            go_left = jnp.where(pv[:, 5] > 0, bit > 0,
                                 v.astype(f32) <= thr_r)
-        child = jnp.where(go_left, pv[:, 3], pv[:, 4]).astype(jnp.int32)
+        rank2_r = pv[:, 3].astype(jnp.int32)
+        child = st.n_nodes + rank2_r + jnp.where(go_left, 0, 1)
         row_leaf = jnp.where(psel, child, p)
 
         # 3. one histogram pass over the SMALLER child of every split: a row
         # participates iff its leaf splits this wave AND it went to the
         # direct (smaller) side; its segment is the leaf's wave rank.
-        to_direct = psel & (go_left == (pv[:, 5] > 0))
-        seg_id = jnp.where(to_direct, pv[:, 6].astype(jnp.int32), w_width)
+        to_direct = psel & (go_left == (pv[:, 4] > 0))
+        seg_id = jnp.where(to_direct, rank2_r >> 1, w_width)
         direct_hist = hist_fn(seg_id, w_width)            # [W, F, B, 3]
 
         # 4. sibling = parent - child (the subtraction trick).
@@ -923,6 +1124,10 @@ def grow_tree_frontier(
 
             bs = jax.vmap(score)(child_hists, child_masks, depth_ok,
                                  child_lo, child_hi, child_vals)
+        if fp_axis is not None:
+            # globalize all 2W child winners in one batched all_gather
+            bs = jax.vmap(
+                lambda b: _fp_reduce_best(b, fp_axis, num_features))(bs)
         active_2 = jnp.concatenate([active_r, active_r])
 
         # 7. commit with TWO packed row scatters: the W split parents
@@ -957,6 +1162,8 @@ def grow_tree_frontier(
             child_hi,                                    # BOUND_HI
             (bs.cat.astype(jnp.float32) if cat_info is not None
              else jnp.zeros((2 * w_width,))),            # CAND_CAT
+            jnp.minimum(jnp.concatenate([prow[:, K.PM], prow[:, K.PM]]),
+                        bs.gain),                        # PM
         ], axis=-1)                                      # [2W, NC]
         oob = jnp.int32(capacity)
         P2 = P.at[jnp.where(active_r, parent_r, oob)].set(
@@ -981,6 +1188,11 @@ def grow_tree_frontier(
         )
 
     st = lax.while_loop(cond, body, st)
+    if exact:
+        newP, new_cat, row_leaf_new, n_leaves_f = _exact_prune(
+            st.nodes, st.cand_catmask, st.row_leaf, num_leaves, cat_info)
+        return (_tree_from_packed(newP, n_leaves_f, cat_info, new_cat),
+                row_leaf_new)
     tree = _tree_from_packed(st.nodes, st.n_leaves, cat_info,
                              st.cand_catmask)
     return tree, st.row_leaf

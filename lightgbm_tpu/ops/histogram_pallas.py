@@ -298,6 +298,10 @@ def hist_fused_pallas(
     k = num_segments * s
     if hist_dtype == "f32x":     # explicit-f32 token (resolve_hist_dtype);
         hist_dtype = "f32"       # forced-pallas callers get the hi/lo split
+    if hist_dtype == "bf16sr":   # opt-in SR variant (histogram.sr_round_bf16
+        from .histogram import sr_round_bf16   # — measured ~3e-4 WORSE than
+        hist_dtype = "bf16"                    # round-to-nearest on Higgs;
+        stats = sr_round_bf16(stats)           # kept for other workloads)
     if hist_dtype == "int8" and n > 16_000_000:
         # int32 accumulation wraps past 2^31/127 ~= 16.9M rows landing in
         # one (segment, bin) cell — beyond that, corrupt histograms would
@@ -310,6 +314,14 @@ def hist_fused_pallas(
         num_features, num_bins, k, chunk_align=512)
     if chunk is None:
         chunk = auto_chunk
+        if hist_dtype == "int8":
+            # Mosaic widens the int8 one-hot/relayout intermediates ~3x
+            # beyond the f32 per_row model (~43 MB scoped VMEM at
+            # chunk=2048 vs the ~16 MB scope, measured r3) — the retuned
+            # estimate above models only the bf16/f32 paths, so auto
+            # chunks above 512 fail to compile at production widths
+            # (ADVICE r4).  Explicit ``chunk=`` still overrides.
+            chunk = min(chunk, 512)
     # transposed [F, n] i32 layout: the kernel's per-feature dynamic slice
     # must be on the MAJOR dim.  This is loop-invariant across the grower's
     # waves, so XLA hoists the transpose out of the growth while_loop.

@@ -70,7 +70,7 @@ def make_fp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                        num_bins: int, hist_impl: str = "auto",
                        row_chunk: int = 131072, is_rf: bool = False,
                        hist_dtype: str = "f32", num_class: int = 1,
-                       cat_key=None):
+                       cat_key=None, wave_width: int = 1):
     """Build the jitted feature-parallel round step for a mesh.
 
     step(bins_fsharded, y, w, bag, pred, fmask_fsharded, hyper, key) ->
@@ -108,9 +108,16 @@ def make_fp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                                (bag > 0).astype(jnp.float32)], axis=-1)
             return grow_tree(
                 bins_l, stats, fmask_l, hyper.ctx(), num_leaves, num_bins,
-                hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
+                # the Booster gate guarantees bynode == 1.0 on the fp path;
+                # None engages the static bynode skip (no per-node
+                # threefry draw, ~20 dead kernels/split — ADVICE r4)
+                hyper.max_depth, ff_bynode=None,
                 key=kc, hist_impl=hist_impl, row_chunk=row_chunk,
-                hist_dtype=hist_dtype, wave_width=1, fp_axis=FEATURE_AXIS,
+                hist_dtype=hist_dtype,
+                # wave growth composes with the split exchange since r5
+                # (categorical datasets drop to the strict fp path inside
+                # grow_tree)
+                wave_width=wave_width, fp_axis=FEATURE_AXIS,
                 cat_info=cat_l)
 
         if num_class > 1:

@@ -135,9 +135,14 @@ def profile_training(params: Dict[str, Any], X, y,
     # "f32x" is the internal explicit-f32 routing token — report the
     # user-facing name
     report["hist_dtype"] = "f32" if hd == "f32x" else hd
-    # the tail policy rides in the SIGN of the static width (models/gbdt
-    # resolve_wave_width) — surface it as a named field, not a negative
-    # width (ADVICE r3)
-    report["wave_width"] = abs(ww)
-    report["wave_tail"] = "greedy" if ww < 0 else "half"
+    # the tail policy rides in the ENCODING of the static width — surface
+    # it as named fields, not the raw encoded int (ADVICE r3); decoded
+    # through the single shared helper (code review r5)
+    from ..models.tree import decode_wave_width
+
+    w_dec, tail, over = decode_wave_width(ww)
+    report["wave_width"] = w_dec
+    report["wave_tail"] = tail
+    if over is not None:
+        report["wave_overgrow_leaves"] = over
     return report

@@ -448,3 +448,29 @@ def test_fp_categorical_matches_serial():
                                       np.asarray(tf.split_feature))
     np.testing.assert_allclose(serial.predict(X), fp.predict(X),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_fp_wave_growth_matches_serial():
+    """tree_learner='feature' with WAVE growth (r5): per-wave split
+    exchange (one batched all_gather for all 2W children) + psum'd
+    partition columns must reproduce the serial frontier grower's model,
+    including the exact tail's overgrow + replay + prune."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(17)
+    n, F = 8192, 10
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = (X[:, 0] * 2 + np.sin(X[:, 1] * 3) + X[:, 2] * X[:, 3]
+         + rng.normal(0, 0.1, n)).astype(np.float32)
+    for tail in ("exact", "greedy"):
+        params = {"objective": "regression", "num_leaves": 31,
+                  "learning_rate": 0.2, "verbosity": -1,
+                  "grow_policy": "frontier", "wave_tail": tail}
+        b_serial = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                             num_boost_round=5)
+        b_fp = lgb.train({**params, "tree_learner": "feature"},
+                         lgb.Dataset(X, label=y), num_boost_round=5)
+        assert b_fp._fp_mesh is not None, "FP path must engage"
+        np.testing.assert_allclose(b_serial.predict(X[:512]),
+                                   b_fp.predict(X[:512]),
+                                   rtol=1e-5, atol=1e-6, err_msg=tail)

@@ -19,6 +19,40 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def goss_leg() -> None:
+    """Subprocess GOSS leg (VERDICT r4 #4: GOSS has never produced an
+    on-chip number — r3's bench section crashed the worker, r4's was
+    budget-starved).  Small n + short dispatches keep it well inside the
+    stable regime; a worker fault here kills only this subprocess."""
+    out = {}
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils.datasets import make_higgs_like
+    from sklearn.metrics import roc_auc_score
+
+    n, rounds = 200_000, 40
+    X, y = make_higgs_like(n)
+    Xv, yv = make_higgs_like(200_000, seed=9)
+    for label, extra in (("goss", {"boosting": "goss", "top_rate": 0.2,
+                                   "other_rate": 0.1}),
+                         ("plain", {})):
+        params = {"objective": "binary", "num_leaves": 63,
+                  "learning_rate": 0.1, "verbosity": -1,
+                  "fused_segment_rounds": 8, **extra}
+        ds = lgb.Dataset(X, label=y)
+        ds.construct()
+        b = lgb.Booster(params, ds)
+        b.update_many(rounds)                        # warm the programs
+        _ = np.asarray(b._pred_train[:4])
+        t0 = time.perf_counter()
+        b.update_many(rounds)
+        _ = np.asarray(b._pred_train[:4])
+        el = time.perf_counter() - t0
+        out[f"{label}_rows_per_s"] = round(n * rounds / el, 1)
+        out[f"{label}_auc"] = round(float(roc_auc_score(
+            yv, np.asarray(b.predict(Xv, num_iteration=rounds)))), 5)
+    print("@@GOSS@@" + json.dumps(out))
+
+
 def main() -> None:
     out = {"ok": False}
     t_start = time.perf_counter()
@@ -70,6 +104,55 @@ def main() -> None:
             float(roc_auc_score(y[:1000], p)), 4)
         assert out["train_auc"] > 0.6
 
+        # 3. exact-tail growth on chip (the r5 conjunction mechanism):
+        # overgrow + strict replay must stay budget-bounded and train
+        booster2 = lgb.train(
+            {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+             "grow_policy": "frontier", "wave_tail": "exact"}, ds,
+            num_boost_round=10)
+        p2 = booster2.predict(X[:1000])
+        out["exact_tail_auc"] = round(
+            float(roc_auc_score(y[:1000], p2)), 4)
+        assert out["exact_tail_auc"] > 0.6
+
+        # 4. int8 histogram compile at PRODUCTION width B=256 (ADVICE r4:
+        # the auto chunk cap must keep Mosaic's widened int8
+        # intermediates inside scoped VMEM)
+        from lightgbm_tpu.ops.histogram_pallas import hist_fused_pallas
+
+        n8 = 40_000
+        bins8 = rng.integers(0, 255, (n8, 28)).astype(np.uint8)
+        stats8 = rng.normal(size=(n8, 3)).astype(np.float32)
+        seg8 = rng.integers(0, 8, n8).astype(np.int32)
+        h8 = np.asarray(hist_fused_pallas(
+            jnp.asarray(bins8), jnp.asarray(stats8), jnp.asarray(seg8),
+            8, 256, hist_dtype="int8", interpret=False))
+        ref8 = np.zeros((8, 28, 256, 3))
+        np.add.at(ref8, (seg8[:, None], np.arange(28)[None, :], bins8),
+                  stats8[:, None, :])
+        int8_err = float(np.max(np.abs(h8 - ref8))
+                         / (np.abs(ref8).max() + 1e-9))
+        out["pallas_int8_b256_rel_err"] = round(int8_err, 6)
+        assert int8_err < 0.05, int8_err   # stochastic-rounded 8-bit g/h
+
+        # 5. GOSS throughput + AUC, subprocess-isolated (worker faults
+        # here cost only the goss keys)
+        import subprocess
+
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--goss-leg"],
+                capture_output=True, text=True, timeout=600)
+            for line in reversed(r.stdout.splitlines()):
+                if line.startswith("@@GOSS@@"):
+                    out.update(json.loads(line[len("@@GOSS@@"):]))
+                    break
+            else:
+                out["goss_error"] = (r.stderr.strip().splitlines()
+                                     or ["no output"])[-1][-200:]
+        except subprocess.TimeoutExpired:
+            out["goss_error"] = "timeout after 600s"
+
         out["ok"] = True
     except Exception as e:  # noqa: BLE001 — single-line JSON contract
         out["error"] = f"{type(e).__name__}: {e}"[:400]
@@ -79,4 +162,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--goss-leg" in sys.argv:
+        goss_leg()
+    else:
+        main()
