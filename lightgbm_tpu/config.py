@@ -301,7 +301,7 @@ _FRAMEWORK_KEYS = {
     "wave_width",          # frontier grower: max splits per histogram pass
     "wave_tail",           # "exact" (strict order via overgrow+replay) |
                            # "greedy" (fewest passes) | "half" (near-strict)
-    "wave_overgrow",       # exact tail: overgrowth factor (default 1.5)
+    "wave_overgrow",       # exact tail: overgrowth factor (default 2.0)
     "linear_k",            # linear_tree: max path features per leaf model
 }
 

@@ -161,7 +161,7 @@ def resolve_wave_width(p: Params, n_rows: int) -> int:
     width = max(1, min(width, 512))
     # wave_tail — how the wave schedule spends the tail of the leaf
     # budget, where wave and strict best-first order can diverge:
-    #   "exact"  — overgrow greedily ~1.5x past num_leaves, then replay
+    #   "exact"  — overgrow greedily ~2x past num_leaves, then replay
     #     strict best-first selection over the realized gains and prune
     #     (models/tree.py _exact_prune).  LightGBM-exact split ORDER at
     #     ~one extra histogram pass over greedy; r4's gap decomposition
@@ -191,7 +191,12 @@ def resolve_wave_width(p: Params, n_rows: int) -> int:
     if tail == "greedy":
         width = -width
     elif tail == "exact":
-        over = float(p.extra.get("wave_overgrow", 1.5))
+        # default overgrowth 2.0: the r5 on-chip gap-vs-overgrow sweep
+        # converged at ~2x (Higgs-1M: 1.5x -> +8.6e-4 vs oracle, 2.0x ->
+        # +0.3..2.1e-4 across oracle draws, 2.5x no better), and at 2x
+        # the 11M throughput still clears the 5x north star with the
+        # partition-fused kernel (PERF.md r5)
+        over = float(p.extra.get("wave_overgrow", 2.0))
         l_over = _exact_overgrow_target(p.num_leaves, width, over)
         width = l_over * 1024 + width
     if p.grow_policy == "frontier":
@@ -324,7 +329,7 @@ def _goss_compact_round(bins, y, w, bag, pred, fmask, hyper: HyperScalars,
         hist_impl=hist_impl, row_chunk=row_chunk, hist_dtype=hist_dtype,
         wave_width=wave_width, cat_info=cat_info, axis_name=axis_name,
         mono=mono, extra_trees=extra_trees, col_bins=col_bins,
-        ic_member=ic_member)
+        ic_member=ic_member, fuse_partition=True)
     if renew_alpha is not None:
         rw = w[idx] * wt
         if renew_scale is not None:
@@ -455,7 +460,7 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                 hist_dtype=hist_dtype, wave_width=wave_width,
                 cat_info=_build_cat_info(cat_key, bins.shape[1]),
                 mono=mono_arr, extra_trees=extra_trees, col_bins=colb,
-                ic_member=ic_member)
+                ic_member=ic_member, fuse_partition=True)
             tree, delta = fit_linear_leaves(
                 tree, row_leaf, xraw, g, h, bag, hyper.linear_lambda,
                 linear_k, row_chunk)
@@ -477,7 +482,7 @@ def _round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
             hist_dtype=hist_dtype, wave_width=wave_width,
             cat_info=_build_cat_info(cat_key, bins.shape[1]),
             mono=mono_arr, extra_trees=extra_trees, col_bins=colb,
-            ic_member=ic_member)
+            ic_member=ic_member, fuse_partition=True)
         if renew_alpha is not None:
             rw = w * bag if renew_scale is None else w * bag * renew_scale(y)
             tree = renew_leaf_values(tree, row_leaf, y - pred, rw,
@@ -565,7 +570,7 @@ def _multi_round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                 row_chunk=row_chunk, hist_dtype=hist_dtype,
                 wave_width=wave_width,
                 cat_info=cat_info, mono=mono_arr, extra_trees=extra_trees,
-                col_bins=colb, ic_member=ic_member)
+                col_bins=colb, ic_member=ic_member, fuse_partition=True)
             if renew_alpha is not None:
                 rw = (w * bag if renew_scale is None
                       else w * bag * renew_scale(y))

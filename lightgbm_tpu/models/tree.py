@@ -389,6 +389,7 @@ def grow_tree(
     col_bins=None,
     ic_member=None,
     wave_tail: str = "half",
+    fuse_partition: bool = False,
 ) -> Tuple[Tree, jnp.ndarray]:
     """Grow one best-first tree.
 
@@ -450,7 +451,8 @@ def grow_tree(
             hist_impl=hist_impl, row_chunk=row_chunk, hist_dtype=hist_dtype,
             cat_info=cat_info, mono=mono, extra_trees=extra_trees,
             col_bins=col_bins, ic_member=ic_member, wave_tail=wave_tail,
-            overgrow_leaves=overgrow_leaves, fp_axis=fp_axis)
+            overgrow_leaves=overgrow_leaves, fp_axis=fp_axis,
+            fuse_partition=fuse_partition)
     n, num_features = bins.shape
     capacity = 2 * num_leaves - 1
     max_depth = jnp.asarray(max_depth, jnp.int32)
@@ -692,7 +694,7 @@ def _exact_prune(P, cand_catmask, row_leaf, num_leaves: int,
     only divergence from true strict order.  The overgrowth waves
     select by PATHMIN (= priority-first extraction order between
     distinct priorities), which expands nodes in near-strict order and
-    makes misses rare at ~1.5x overgrowth (validated against the strict
+    makes misses rare at the ~2x default overgrowth (validated vs the strict
     grower in tests/test_exact_wave.py; quality impact measured in the
     bench's parity section).
 
@@ -828,6 +830,7 @@ def grow_tree_frontier(
     wave_tail: str = "half",
     overgrow_leaves: Optional[int] = None,
     fp_axis: Optional[str] = None,
+    fuse_partition: bool = False,
 ) -> Tuple[Tree, jnp.ndarray]:
     """Best-first growth in WAVES: up to ``wave_width`` splits per data pass.
 
@@ -868,6 +871,29 @@ def grow_tree_frontier(
                    if exact else num_leaves)
     capacity = 2 * grow_leaves - 1
     w_width = min(int(wave_width), grow_leaves - 1)
+
+    # partition-fused wave kernel (histogram + row routing in one pallas
+    # call — r5 trace: ~22 ms/wave of XLA-side partition work at 11M rows
+    # reads data the kernel already holds in VMEM).  Static eligibility:
+    # single-model growth (callers opt in; vmapped/batched growth keeps
+    # the custom-vmap wide-segment route), no feature sharding, no
+    # categorical subset splits, a pallas-routed dtype, and the whole
+    # feature axis in one VMEM block (phase 1 selects each row's split
+    # feature from the resident bins tile).
+    from ..ops.histogram_pallas import partition_fusable
+
+    exact_dtype = hist_dtype == "f32x"
+    route_pallas = (hist_impl == "pallas"
+                    or (hist_impl == "auto" and not exact_dtype
+                        and jax.default_backend() == "tpu"))
+    fuse_part = (fuse_partition and fp_axis is None and cat_info is None
+                 and hist_dtype != "int8" and route_pallas
+                 and w_width > 1
+                 # the per-row field lookup runs at bf16 DEFAULT
+                 # precision — every table value (feature id, bin,
+                 # 2*rank child offset) must be an exact bf16 integer
+                 and max(num_features, 2 * w_width, num_bins) <= 256
+                 and partition_fusable(num_features, num_bins, w_width))
     max_depth = jnp.asarray(max_depth, jnp.int32)
     neg_inf = jnp.float32(-jnp.inf)
     if key is None:
@@ -945,6 +971,23 @@ def grow_tree_frontier(
     bins_i32 = bins.astype(jnp.int32)
     iota_w = lax.iota(jnp.int32, w_width)
 
+    if fuse_part:
+        # loop-invariant kernel operands prepared ONCE (the in-call
+        # pad/convert re-ran per wave, ~2.7 ms each at 11M — r5 trace)
+        from ..ops.histogram_pallas import (hist_partition_fused_pallas,
+                                            prepare_wave_operands)
+
+        stats_prep_src = stats
+        if hist_dtype == "bf16sr":
+            # the opt-in SR variant must quantize here too — the fused
+            # path bypasses compute_histograms where SR normally applies
+            from ..ops.histogram import sr_round_bf16
+
+            stats_prep_src = sr_round_bf16(stats)
+        bins_t_prep, stats_t_prep, part_chunk = prepare_wave_operands(
+            bins, stats_prep_src, num_bins, w_width)
+        n_pad_rows = bins_t_prep.shape[1]
+
     def cond(st: _WaveState):
         P = st.nodes
         gains = jnp.where(P[:, K.IS_LEAF] > 0.5, P[:, K.CAND_GAIN], neg_inf)
@@ -1001,75 +1044,130 @@ def grow_tree_frontier(
                          active_r)                        # node -> direct side
         p = st.row_leaf
         f32 = jnp.float32
-        # child ids ride as WAVE-RELATIVE offsets (2*rank <= 2W <= 256),
-        # not absolute node ids: absolute ids exceed 256 whenever the
-        # (overgrown) capacity does, which would force the HIGHEST-
-        # precision dot below — at 11M rows that lookup is the wave's
-        # second-largest cost.  child = n_nodes + offset reconstructs the
-        # absolute id with a traced scalar add after the lookup.
-        cols = [sel.astype(f32), P[:, K.CAND_FEAT],
-                P[:, K.CAND_BIN], (2 * rank).astype(f32),
-                dl_of.astype(f32)]
-        if cat_info is not None:
-            cols.append(P[:, K.CAND_CAT])
-        # DEFAULT precision (native-rate bf16 dot) is exact only while every
-        # table value is an integer <= 256 (bf16 has an 8-bit significand);
-        # feature ids beyond 256 need the full-precision dot or rows
-        # partition on corrupted ids.  (The one-hot INDEX side is exact at
-        # any capacity — only table VALUES are constrained.)  Under
-        # feature sharding the table carries GLOBAL feature ids whose
-        # range this shard cannot bound statically — always exact there.
-        exact_in_bf16 = (fp_axis is None
-                         and max(num_features, 2 * w_width,
-                                 num_bins) <= 256)
-        pv = lookup_rows(p, jnp.stack(cols, axis=1),
-                         precision=(lax.Precision.DEFAULT if exact_in_bf16
-                                    else lax.Precision.HIGHEST))
-        psel = pv[:, 0] > 0
-        feat_r = pv[:, 1].astype(jnp.int32)
-        thr_r = pv[:, 2]
-        # per-row split value WITHOUT take_along_axis (same gather problem):
-        # masked lane-reduction over the feature axis.  Under feature
-        # sharding the ids are global: match against this shard's global
-        # column range and psum — the owning shard contributes the codes
-        # (the [n] bitmap exchange of upstream's feature-parallel split,
-        # batched over the whole wave)
-        if fp_axis is not None:
-            gids = (lax.axis_index(fp_axis) * num_features
-                    + lax.iota(jnp.int32, num_features))
-            fmatch = feat_r[:, None] == gids[None, :]
-            v = lax.psum(jnp.sum(jnp.where(fmatch, bins_i32, 0), axis=1),
-                         fp_axis)
+        if fuse_part:
+            # 2+3 FUSED: one transposed per-row lookup of the wave's node
+            # fields, then the pallas kernel routes rows AND builds the
+            # direct-child histograms in a single pass (phase-1 feature
+            # select + phase-2 folded dots — _fused_part_kernel).  The
+            # one-hot compares against the W SPLITTING PARENTS only, not
+            # the full node table (rows in any other leaf produce an
+            # all-zero column = sel 0, exactly the wanted semantics) —
+            # the full-table compare was ~6 ms/wave at 11M rows.  Table
+            # values (sel/feat/thr/rank2/dl) are all <= 256 under the
+            # single-f-block gate, so the dot stays bf16-exact.
+            zw = jnp.zeros(w_width)
+            tbl_w = jnp.stack([active_r.astype(f32),
+                               prow[:, K.CAND_FEAT], prow[:, K.CAND_BIN],
+                               (2 * iota_w).astype(f32),
+                               direct_left.astype(f32), zw, zw, zw],
+                              axis=1)                        # [W, 8]
+            oh_w = (parent_r[:, None] == p[None, :])         # [W, n]
+            pv_t = lax.dot_general(
+                tbl_w.astype(f32).T, oh_w.astype(f32),
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT)             # [8, n]
+            if n_pad_rows != n:
+                pv_t = jnp.pad(pv_t, ((0, 0), (0, n_pad_rows - n)))
+            direct_hist, enc = hist_partition_fused_pallas(
+                bins_t_prep, stats_t_prep, pv_t, w_width, num_bins,
+                part_chunk,
+                hist_dtype=("f32" if hist_dtype in ("f32", "f32x")
+                            else "bf16"))
+            direct_hist = histogram_psum(direct_hist, axis_name)
+            enc = enc[:n]
+            row_leaf = jnp.where(enc > 0, st.n_nodes + enc - 1, p)
         else:
-            fmatch = (feat_r[:, None]
-                      == lax.iota(jnp.int32, num_features)[None, :])
-            v = jnp.sum(jnp.where(fmatch, bins_i32, 0), axis=1)
-        if cat_info is None:
-            go_left = v.astype(f32) <= thr_r
-        else:
-            # category-subset membership: one-hot lookup of the row's mask
-            # row, then select bit v — both stay fused elementwise/matmul
-            mrow = lookup_rows(p, st.cand_catmask.astype(f32),
-                               precision=lax.Precision.DEFAULT)  # [n, B]
-            bit = jnp.sum(
-                jnp.where(v[:, None] == lax.iota(jnp.int32, num_bins)[None, :],
-                          mrow, 0.0), axis=1)
-            go_left = jnp.where(pv[:, 5] > 0, bit > 0,
-                                v.astype(f32) <= thr_r)
-        rank2_r = pv[:, 3].astype(jnp.int32)
-        child = st.n_nodes + rank2_r + jnp.where(go_left, 0, 1)
-        row_leaf = jnp.where(psel, child, p)
+            # child ids ride as WAVE-RELATIVE offsets (2*rank <= 2W <=
+            # 256), not absolute node ids: absolute ids exceed 256
+            # whenever the (overgrown) capacity does, which would force
+            # the HIGHEST-precision dot below.  child = n_nodes + offset
+            # reconstructs the absolute id after the lookup.
+            cols = [sel.astype(f32), P[:, K.CAND_FEAT],
+                    P[:, K.CAND_BIN], (2 * rank).astype(f32),
+                    dl_of.astype(f32)]
+            if cat_info is not None:
+                cols.append(P[:, K.CAND_CAT])
+            # DEFAULT precision (native-rate bf16 dot) is exact only while
+            # every table value is an integer <= 256 (bf16 has an 8-bit
+            # significand); feature ids beyond 256 need the full-precision
+            # dot or rows partition on corrupted ids.  (The one-hot INDEX
+            # side is exact at any capacity — only table VALUES are
+            # constrained.)  Under feature sharding the table carries
+            # GLOBAL feature ids whose range this shard cannot bound
+            # statically — always exact there.
+            exact_in_bf16 = (fp_axis is None
+                             and max(num_features, 2 * w_width,
+                                     num_bins) <= 256)
+            pv = lookup_rows(p, jnp.stack(cols, axis=1),
+                             precision=(lax.Precision.DEFAULT
+                                        if exact_in_bf16
+                                        else lax.Precision.HIGHEST))
+            psel = pv[:, 0] > 0
+            feat_r = pv[:, 1].astype(jnp.int32)
+            thr_r = pv[:, 2]
+            # per-row split value WITHOUT take_along_axis (same gather
+            # problem): masked lane-reduction over the feature axis.
+            # Under feature sharding the ids are global: match against
+            # this shard's global column range and psum — the owning
+            # shard contributes the codes (the [n] bitmap exchange of
+            # upstream's feature-parallel split, batched over the wave)
+            if fp_axis is not None:
+                gids = (lax.axis_index(fp_axis) * num_features
+                        + lax.iota(jnp.int32, num_features))
+                fmatch = feat_r[:, None] == gids[None, :]
+                v = lax.psum(
+                    jnp.sum(jnp.where(fmatch, bins_i32, 0), axis=1),
+                    fp_axis)
+            else:
+                fmatch = (feat_r[:, None]
+                          == lax.iota(jnp.int32, num_features)[None, :])
+                v = jnp.sum(jnp.where(fmatch, bins_i32, 0), axis=1)
+            if cat_info is None:
+                go_left = v.astype(f32) <= thr_r
+            else:
+                # category-subset membership: one-hot lookup of the row's
+                # mask row, then select bit v — both stay fused
+                mrow = lookup_rows(p, st.cand_catmask.astype(f32),
+                                   precision=lax.Precision.DEFAULT)
+                bit = jnp.sum(
+                    jnp.where(v[:, None]
+                              == lax.iota(jnp.int32, num_bins)[None, :],
+                              mrow, 0.0), axis=1)
+                go_left = jnp.where(pv[:, 5] > 0, bit > 0,
+                                    v.astype(f32) <= thr_r)
+            rank2_r = pv[:, 3].astype(jnp.int32)
+            child = st.n_nodes + rank2_r + jnp.where(go_left, 0, 1)
+            row_leaf = jnp.where(psel, child, p)
 
-        # 3. one histogram pass over the SMALLER child of every split: a row
-        # participates iff its leaf splits this wave AND it went to the
-        # direct (smaller) side; its segment is the leaf's wave rank.
-        to_direct = psel & (go_left == (pv[:, 4] > 0))
-        seg_id = jnp.where(to_direct, rank2_r >> 1, w_width)
-        direct_hist = hist_fn(seg_id, w_width)            # [W, F, B, 3]
+            # 3. one histogram pass over the SMALLER child of every
+            # split: a row participates iff its leaf splits this wave AND
+            # it went to the direct (smaller) side; its segment is the
+            # leaf's wave rank.
+            to_direct = psel & (go_left == (pv[:, 4] > 0))
+            seg_id = jnp.where(to_direct, rank2_r >> 1, w_width)
+            direct_hist = hist_fn(seg_id, w_width)        # [W, F, B, 3]
 
-        # 4. sibling = parent - child (the subtraction trick).
+        # 4. sibling = parent - child (the subtraction trick).  The cache
+        # gather and update are ONE-HOT MATMULS, not gather/scatter ops:
+        # the r5 trace showed XLA materializing wholesale copies of the
+        # [grow_leaves, F, B, 3] cache around the scatter (two ~59 ms
+        # async copies per wave at the 11M o2.0 shape, co-critical with
+        # the kernel stream), while the matmul form reads the cache once
+        # and commits a pure += the while-carry can alias in place.
+        # Exactness: one-hot factors are exact at every precision and
+        # HIGHEST keeps the f32 cache values bit-exact.
+        fb3 = num_features * num_bins * 3
+        cache_flat = st.hist_cache.reshape(grow_leaves, fb3)
         parent_slot = st.node_slot[parent_r]              # [W]
-        parent_hist = st.hist_cache[parent_slot]          # [W, F, B, 3]
+        oh_p = (parent_slot[:, None]
+                == lax.iota(jnp.int32, grow_leaves)[None, :])
+        parent_hist = lax.dot_general(
+            oh_p.astype(f32), cache_flat,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
+        ).reshape(w_width, num_features, num_bins, 3)
         other_hist = parent_hist - direct_hist
         dl = direct_left[:, None, None, None]
         left_hist = jnp.where(dl, direct_hist, other_hist)
@@ -1077,8 +1175,23 @@ def grow_tree_frontier(
 
         left_slot = parent_slot                           # reuse parent slot
         right_slot = st.n_leaves + iota_w
-        cache = _scatter(st.hist_cache, left_slot, left_hist, active_r)
-        cache = _scatter(cache, right_slot, right_hist, active_r)
+        # mask-and-add: zero the overwritten rows, matmul-add the EXACT
+        # new values (a delta formulation would set left = parent +
+        # (left - parent), off by ~ulp(parent) in f32 — an error the old
+        # scatter never had, compounding through future subtractions)
+        slot2 = jnp.concatenate([left_slot, right_slot])  # [2W]
+        act2w = jnp.concatenate([active_r, active_r])
+        slot2m = jnp.where(act2w, slot2, -1)
+        q = (lax.iota(jnp.int32, grow_leaves)[:, None]
+             == slot2m[None, :])                          # [L, 2W]
+        keep = 1.0 - jnp.any(q, axis=1).astype(f32)       # [L]
+        newvals = jnp.concatenate([left_hist, right_hist])
+        cache = (cache_flat * keep[:, None] + lax.dot_general(
+            q.astype(f32), newvals.reshape(2 * w_width, fb3),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
+        )).reshape(st.hist_cache.shape)
         node_slot = _scatter(st.node_slot, nl_r, left_slot, active_r)
         node_slot = _scatter(node_slot, nr_r, right_slot, active_r)
 

@@ -404,6 +404,178 @@ def hist_fused_pallas(
     return out.transpose(2, 0, 1, 3)
 
 
+def _fused_part_kernel(bins_ref, stats_ref, pv_ref, out_ref, enc_ref, *,
+                       num_features: int, num_bins: int, num_segments: int,
+                       hist_dtype: str):
+    """Wave histogram + ROW PARTITION in one kernel (single f-block).
+
+    The r5 trace at Higgs-11M showed ~22 ms/wave of XLA-side partition
+    work around a ~117 ms kernel: an [n, F] lane-reduction to pick each
+    row's split-feature code, a 128-lane-padded [n, 5] lookup
+    materialization, and a per-wave re-pad of the bins operand.  All of
+    it reads data this kernel already holds in VMEM, so the wave's
+    routing moves in here:
+
+      pv_ref [8, chunk] f32 — per-row node fields from ONE transposed
+        lookup (rows: sel, feat, thr, rank2, direct-left; 3 zero pads);
+      phase 1: v = bins[feat] via a fori_loop feature select (VMEM reads,
+        no HBM); go_left = v <= thr; seg = wave rank where the row moves
+        to its split's DIRECT (smaller) child, else num_segments;
+      enc_ref [1, chunk] i32 — 1 + rank2 + went-right for moved rows,
+        0 otherwise (the caller adds the wave's traced node base);
+      phase 2: the standard segment-folded one-hot dots, with seg now
+        produced in-register.
+    """
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    chunk = bins_ref.shape[1]
+    s = stats_ref.shape[0]
+    w = num_segments
+
+    sel = pv_ref[0, :]
+    feat = pv_ref[1, :]
+    thr = pv_ref[2, :]
+    rank2 = pv_ref[3, :]
+    dl = pv_ref[4, :]
+
+    # phase 1: per-row split value (the row's code at its leaf's split
+    # feature) — F VMEM-resident selects, no extra HBM traffic
+    def vbody(f, v):
+        code = bins_ref[pl.dslice(f, 1), :].astype(jnp.float32)  # [1, chunk]
+        return jnp.where(feat == f, code[0, :], v)
+
+    v = lax.fori_loop(0, num_features, vbody, jnp.zeros((chunk,),
+                                                        jnp.float32))
+    psel = sel > 0.0
+    go_left = v <= thr
+    to_direct = psel & (go_left == (dl > 0.0))
+    seg = jnp.where(to_direct, (rank2 * 0.5).astype(jnp.int32),
+                    jnp.int32(w)).reshape(1, chunk)
+    enc_ref[:] = jnp.where(
+        psel, rank2.astype(jnp.int32) + jnp.where(go_left, 0, 1) + 1,
+        0).reshape(1, chunk)
+
+    # phase 2: standard segment-folded accumulation (see _fused_kernel)
+    stats = stats_ref[:]
+    iota_r = lax.broadcasted_iota(jnp.int32, (w * s, chunk), 0)
+    seg_match = seg == iota_r // s
+    proj_t = (lax.broadcasted_iota(jnp.int32, (w * s, s), 0) % s
+              == lax.broadcasted_iota(jnp.int32, (w * s, s), 1))
+    spread = lax.dot_general(
+        proj_t.astype(jnp.float32), stats.astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    operand = jnp.where(seg_match, spread, 0.0).astype(jnp.bfloat16)
+    iota_bt = lax.broadcasted_iota(jnp.int32, (num_bins, chunk), 0)
+
+    def body(f, _):
+        codes_t = bins_ref[pl.dslice(f, 1), :]
+        onehot_t = (iota_bt == codes_t).astype(jnp.bfloat16)
+        tile = lax.dot_general(
+            onehot_t, operand,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out_ref[pl.dslice(f, 1), :, :] += tile[None]
+        return _
+
+    lax.fori_loop(0, bins_ref.shape[0], body, 0)
+
+
+def partition_fusable(num_features: int, num_bins: int, num_segments: int,
+                      s: int = 3) -> bool:
+    """Static gate for the partition-fused wave kernel: the whole feature
+    axis must fit one VMEM block (phase 1 needs every feature's codes)."""
+    f_blk, n_fblk, _, _ = _vmem_blocking(num_features, num_bins,
+                                         num_segments * s)
+    return n_fblk == 1
+
+
+def prepare_wave_operands(bins: jnp.ndarray, stats: jnp.ndarray,
+                          num_bins: int, num_segments: int):
+    """One-time (per tree) prep for :func:`hist_partition_fused_pallas`:
+    transpose + row-pad the loop-invariant operands OUTSIDE the growth
+    while_loop (the in-call pad/convert re-ran per wave — ~2.7 ms each at
+    11M rows, r5 trace)."""
+    n, num_features = bins.shape
+    s = stats.shape[1]
+    k = num_segments * s
+    f_blk, n_fblk, f_pad, chunk = _vmem_blocking(num_features, num_bins, k,
+                                                 chunk_align=512)
+    assert n_fblk == 1, "partition fusion requires a single feature block"
+    n_chunks = -(-n // chunk)
+    pad = n_chunks * chunk - n
+    bins_t = bins.astype(jnp.int32).T
+    stats_t = stats.T
+    if pad:
+        bins_t = jnp.pad(bins_t, ((0, 0), (0, pad)))
+        stats_t = jnp.pad(stats_t, ((0, 0), (0, pad)))
+    return bins_t, stats_t, chunk
+
+
+def hist_partition_fused_pallas(
+    bins_t: jnp.ndarray,         # [F, n_pad] i32 (prepare_wave_operands)
+    stats_t: jnp.ndarray,        # [S, n_pad] f32 (prepare_wave_operands)
+    pv_t: jnp.ndarray,           # [8, n_pad] f32 per-row node fields
+    num_segments: int,
+    num_bins: int,
+    chunk: int,
+    interpret: bool | None = None,
+    hist_dtype: str = "bf16",
+):
+    """Fused wave pass: histogram over the direct children PLUS the row
+    partition (see _fused_part_kernel).  Returns
+    (hist f32 [num_segments, F, num_bins, S], enc i32 [n_pad]).
+    """
+    num_features, n_pad = bins_t.shape
+    s = stats_t.shape[0]
+    k = num_segments * s
+    n_chunks = n_pad // chunk
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+
+    def one_pass(stats_arr):
+        return pl.pallas_call(
+            functools.partial(_fused_part_kernel,
+                              num_features=num_features,
+                              num_bins=num_bins,
+                              num_segments=num_segments,
+                              hist_dtype="bf16"),
+            grid=(n_chunks,),
+            in_specs=[
+                pl.BlockSpec((num_features, chunk), lambda c: (0, c),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((s, chunk), lambda c: (0, c),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((8, chunk), lambda c: (0, c),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((num_features, num_bins, k), lambda c: (0, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, chunk), lambda c: (0, c),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((num_features, num_bins, k),
+                                     jnp.float32),
+                jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
+            ],
+            interpret=interpret,
+        )(bins_t, stats_arr, pv_t)
+
+    if hist_dtype in ("f32", "f32x"):
+        hi = stats_t.astype(jnp.bfloat16).astype(jnp.float32)
+        h1, enc = one_pass(hi)
+        h2, _ = one_pass(stats_t - hi)
+        out = h1 + h2
+    else:
+        out, enc = one_pass(stats_t)
+    out = out.reshape(num_features, num_bins, num_segments, s)
+    return out.transpose(2, 0, 1, 3), enc[0]
+
+
 def hist_fused_pallas_batched(
     bins: jnp.ndarray,           # [n, F] shared bin codes
     stats: jnp.ndarray,          # [E, n, S] per-element statistics

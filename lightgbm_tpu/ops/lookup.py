@@ -41,3 +41,10 @@ def lookup_values(idx: jnp.ndarray, values: jnp.ndarray,
                   precision=lax.Precision.HIGHEST) -> jnp.ndarray:
     """f32 ``values[M]`` gathered at ``idx i32[n]`` -> f32 ``[n]``."""
     return lookup_rows(idx, values[:, None], precision)[:, 0]
+
+
+# (a transposed [K, n]-output lookup variant lived here briefly; the one
+# consumer — the frontier grower's fused wave partition — compares rows
+# against the wave's PARENT IDS rather than a table index space, so it
+# builds its own one-hot inline.  The layout lesson it encoded survives
+# there: put the row axis on the 128-lane minor dim of small-K outputs.)

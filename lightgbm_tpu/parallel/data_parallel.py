@@ -175,7 +175,7 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
             row_chunk=row_chunk, hist_dtype=hist_dtype,
             wave_width=wave_width, mono=mono_arr, extra_trees=extra_trees,
             col_bins=colb, ic_member=ic_member,
-            cat_info=make_cat(bins.shape[1]))
+            cat_info=make_cat(bins.shape[1]), fuse_partition=True)
         shrink = jnp.where(is_rf, 1.0, hyper.learning_rate)
         new_pred = pred + shrink * lookup_values(row_leaf, tree.leaf_value)
         return tree, new_pred
@@ -222,7 +222,7 @@ def make_dp_grow_step(mesh: Mesh, num_leaves: int, num_bins: int,
             hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
             key=key, axis_name=DATA_AXIS, hist_impl=hist_impl,
             row_chunk=row_chunk, hist_dtype=hist_dtype,
-            wave_width=wave_width)
+            wave_width=wave_width, fuse_partition=True)
         return tree, row_leaf
 
     sharded = jax.shard_map(
