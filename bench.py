@@ -729,8 +729,13 @@ def main() -> None:
     section("higgs_quality",
             ["higgs_quality_section(1_000_000, 100)",
              "higgs_quality_section(1_000_000, 40)"], 900)
-    section("higgs_goss", "bench_higgs_goss()", 600)
+    # diamonds BEFORE goss: it is the driver's PRIMARY metric (`value`)
+    # and cheap; the r5 2400s self-run lost 600s to a goss timeout and
+    # would have starved diamonds at the driver's 1500s budget
     section("diamonds", "diamonds_section()", 600)
+    section("higgs_goss", ["bench_higgs_goss()",
+                           "bench_higgs_goss(500_000, 60)"],
+            int(min(420, max(remaining() * 0.25, 90))))
     section("mslr", "bench_mslr()", 600)
     section("criteo_efb", "bench_criteo_efb()", 600)
     # parity-preset corroboration (strict grower + exact f32 on the XLA

@@ -336,8 +336,12 @@ def _goss_compact_round(bins, y, w, bag, pred, fmask, hyper: HyperScalars,
             rw = rw * renew_scale(y[idx])
         tree = renew_leaf_values(tree, rl_c, y[idx] - pred[idx],
                                  rw, renew_alpha)
+    # convergence-checked traversal (depth_cap=None): iterates the tree's
+    # ACTUAL depth — the num_leaves-deep static scan was 3.7 s/round at
+    # 500k rows (r5 trace), ~10x the whole histogram work, and any
+    # optimistic static bound is unsound under stalled waves
     new_pred = pred + hyper.learning_rate * predict_tree_binned(
-        tree, bins, num_leaves)
+        tree, bins, None)
     return tree, new_pred
 
 
@@ -1034,8 +1038,17 @@ class Booster:
 
         p = self.params
         ranking = getattr(self.obj, "needs_group", False)
-        if (p.boosting == "dart" or p.linear_tree
+        if (p.boosting == "dart"
                 or getattr(self.obj, "renew_alpha", None) is not None
+                # linear leaves under the mesh since r5: plain
+                # single-class gbdt (the ridge psum path,
+                # parallel.make_dp_linear_train_step)
+                or (p.linear_tree and (p.boosting != "gbdt"
+                                       or self._num_class > 1 or ranking
+                                       or self._mono_key is not None
+                                       or self._ic_key is not None
+                                       or self._cat_key is not None
+                                       or p.extra_trees))
                 or (ranking and (p.boosting != "gbdt"
                                  or self._mono_key is not None
                                  or self._ic_key is not None
@@ -1044,7 +1057,8 @@ class Booster:
             warnings.warn(
                 f"tree_learner='{p.tree_learner}' currently supports "
                 "gbdt/rf/goss boosting without leaf renewal "
-                "(ranking: plain gbdt only); training serially",
+                "(ranking: plain gbdt only; linear_tree: plain "
+                "single-class gbdt); training serially",
                 stacklevel=3)
             return
         n_pad = int(self.train_set.row_mask.shape[0])
@@ -1073,6 +1087,8 @@ class Booster:
          self._bag) = shard_rows(
             self._dp_mesh, ds.X_binned, ds.y, self._w_eff,
             self._pred_train, self._bag)
+        if self._xraw is not None:   # linear_tree under the mesh (r5)
+            self._dp_xraw = shard_rows(self._dp_mesh, self._xraw)
 
     def _maybe_setup_fp(self) -> None:
         """Shard the FEATURE axis over the local mesh (LightGBM
@@ -1314,6 +1330,19 @@ class Booster:
                                 round_key)
             new_pred = self._pred_train + jnp.float32(p.learning_rate) \
                 * lookup_values(row_leaf, tree.leaf_value)
+        elif getattr(self, "_dp_mesh", None) is not None and \
+                self._linear_k is not None:
+            from ..parallel.data_parallel import make_dp_linear_train_step
+
+            fn = make_dp_linear_train_step(
+                self._dp_mesh, self._obj_key, p.num_leaves, self._num_bins,
+                p.extra.get("hist_impl", "auto"),
+                int(p.extra.get("row_chunk", 131072)),
+                resolve_hist_dtype(p, eff_rows),
+                resolve_wave_width(p, eff_rows), self._linear_k)
+            tree, new_pred = fn(self._dp_bins, self._dp_y, self._dp_w,
+                                self._bag, self._pred_train, self._dp_xraw,
+                                fmask, self._hyper, round_key)
         elif getattr(self, "_dp_mesh", None) is not None:
             from ..parallel.data_parallel import make_dp_train_step
 

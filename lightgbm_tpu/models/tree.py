@@ -1334,7 +1334,9 @@ def empty_forest(num_trees: int, num_leaves: int) -> Tree:
 def fit_linear_leaves(tree: Tree, row_leaf: jnp.ndarray, xraw: jnp.ndarray,
                       g: jnp.ndarray, h: jnp.ndarray, bag: jnp.ndarray,
                       linear_lambda, k_feats: int,
-                      row_chunk: int = 131072) -> Tuple[Tree, jnp.ndarray]:
+                      row_chunk: int = 131072,
+                      axis_name: Optional[str] = None
+                      ) -> Tuple[Tree, jnp.ndarray]:
     """Fit ridge-regularized linear models in every leaf (upstream
     ``linear_tree``, src/treelearner/linear_tree_learner.cpp re-derived
     tensor-first).
@@ -1436,6 +1438,13 @@ def fit_linear_leaves(tree: Tree, row_leaf: jnp.ndarray, xraw: jnp.ndarray,
         A, bvec = chunk(0, (A0, b0))
     else:
         A, bvec = lax.fori_loop(0, n_chunks, chunk, (A0, b0))
+    if axis_name is not None:
+        # data-parallel linear leaves: per-shard Gram/moment partials
+        # merge with one psum (the same allreduce shape as the histogram
+        # merge), then every shard solves the identical batched system —
+        # coefficients replicated by construction
+        A = lax.psum(A, axis_name)
+        bvec = lax.psum(bvec, axis_name)
 
     eye = jnp.eye(kp1, dtype=jnp.float32)
     beta = jnp.linalg.solve(A + (lam + 1e-6) * eye[None],

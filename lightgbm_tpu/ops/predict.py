@@ -26,21 +26,27 @@ from jax import lax
 DEFAULT_TREE_CHUNK = 32
 
 
-def predict_tree_binned(tree, bins: jnp.ndarray, max_depth_cap: int) -> jnp.ndarray:
+def predict_tree_binned(tree, bins: jnp.ndarray,
+                        max_depth_cap=None) -> jnp.ndarray:
     """Leaf value per row for one tensorized tree.
 
     Args:
       tree: Tree namedtuple of arrays (see models.tree.Tree).
       bins: uint8/int32 [n, F] binned features.
-      max_depth_cap: static traversal depth bound (num_leaves is always safe;
-        ``forest_depth_cap`` gives the tight bound).
+      max_depth_cap: static traversal depth bound (num_leaves is always
+        safe; ``forest_depth_cap`` gives the tight bound).  ``None`` runs
+        a convergence-checked ``while_loop`` instead — it iterates
+        exactly the tree's ACTUAL depth (wave-grown trees are usually
+        ~10 deep where num_leaves-1 would be 126 scan steps; an
+        optimistic static bound is UNSOUND because wave growth can stall
+        to one split per wave — code review r5).
 
     Returns f32 [n] raw leaf values (no shrinkage applied).
     """
     n = bins.shape[0]
     bins = bins.astype(jnp.int32)
 
-    def step(node, _):
+    def advance(node):
         feat = tree.split_feature[node]            # [n]
         thr = tree.split_bin[node]                 # [n]
         code = jnp.take_along_axis(bins, feat[:, None], axis=1)[:, 0]
@@ -49,11 +55,16 @@ def predict_tree_binned(tree, bins: jnp.ndarray, max_depth_cap: int) -> jnp.ndar
             left = jnp.where(tree.is_cat_split[node],
                              tree.cat_mask[node, code], left)
         nxt = jnp.where(left, tree.left[node], tree.right[node])
-        node = jnp.where(tree.is_leaf[node], node, nxt)
-        return node, None
+        return jnp.where(tree.is_leaf[node], node, nxt)
 
     node0 = jnp.zeros(n, dtype=jnp.int32)
-    node, _ = lax.scan(step, node0, None, length=max_depth_cap)
+    if max_depth_cap is None:
+        node = lax.while_loop(
+            lambda nd: jnp.any(~tree.is_leaf[nd]),
+            advance, node0)
+    else:
+        node, _ = lax.scan(lambda nd, _: (advance(nd), None), node0, None,
+                           length=max_depth_cap)
     return tree.leaf_value[node]
 
 

@@ -199,6 +199,54 @@ def dp_full_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
 
 
 @functools.lru_cache(maxsize=None)
+def make_dp_linear_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
+                              num_bins: int, hist_impl: str = "auto",
+                              row_chunk: int = 131072,
+                              hist_dtype: str = "f32",
+                              wave_width: int = 1, linear_k: int = 8):
+    """Data-parallel ``linear_tree`` round (r5 breadth): constant-leaf
+    growth shards rows with psum-merged histograms as usual, then every
+    leaf's ridge system accumulates per shard and merges with ONE psum of
+    the [capacity, K+1, K+1] Gram tensors (tree.fit_linear_leaves
+    axis_name) — the solve is replicated, so coefficients match serial
+    training exactly (tested vs serial on the CPU mesh).
+
+    step(bins_sh, y_sh, w_sh, bag_sh, pred_sh, xraw_sh, fmask, hyper,
+    key) -> (tree [replicated], new_pred [row-sharded]).
+    """
+    from ..models.gbdt import _rebuild_objective
+    from ..models.tree import fit_linear_leaves, grow_tree
+
+    obj = _rebuild_objective(obj_key)
+
+    def step(bins, y, w, bag, pred, xraw, feature_mask,
+             hyper: HyperScalars, key):
+        g, h = obj.grad_hess(pred, y, w)
+        stats = jnp.stack([g * bag, h * bag, bag], axis=-1)
+        tree, row_leaf = grow_tree(
+            bins, stats, feature_mask, hyper.ctx(), num_leaves, num_bins,
+            hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
+            key=key, axis_name=DATA_AXIS, hist_impl=hist_impl,
+            row_chunk=row_chunk, hist_dtype=hist_dtype,
+            wave_width=wave_width, fuse_partition=True)
+        tree, delta = fit_linear_leaves(
+            tree, row_leaf, xraw, g, h, bag, hyper.linear_lambda,
+            linear_k, row_chunk, axis_name=DATA_AXIS)
+        new_pred = pred + hyper.learning_rate * delta
+        return tree, new_pred
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS), P(DATA_AXIS), P(), P(), P()),
+        out_specs=(P(), P(DATA_AXIS)),
+        check_vma=False,  # tree replicated by construction via psum
+    )
+    return jax.jit(sharded)
+
+
+@functools.lru_cache(maxsize=None)
 def make_dp_grow_step(mesh: Mesh, num_leaves: int, num_bins: int,
                       hist_impl: str = "auto", row_chunk: int = 131072,
                       wave_width: int = 1, hist_dtype: str = "f32"):

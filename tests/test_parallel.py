@@ -474,3 +474,27 @@ def test_fp_wave_growth_matches_serial():
         np.testing.assert_allclose(b_serial.predict(X[:512]),
                                    b_fp.predict(X[:512]),
                                    rtol=1e-5, atol=1e-6, err_msg=tail)
+
+
+def test_dp_linear_tree_matches_serial():
+    """linear_tree under tree_learner='data' (r5): constant-leaf growth
+    shards rows with psum'd histograms, the per-leaf ridge systems merge
+    with one psum of the Gram tensors, and the result must match serial
+    linear-tree training."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(23)
+    n, F = 2048, 6
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = (1.5 * X[:, 0] + np.where(X[:, 1] > 0, 2 * X[:, 2], -X[:, 2])
+         + 0.05 * rng.normal(size=n)).astype(np.float32)
+    params = {"objective": "regression", "num_leaves": 15,
+              "learning_rate": 0.2, "verbosity": -1, "linear_tree": True,
+              "linear_lambda": 0.01, "grow_policy": "leafwise"}
+    b_serial = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                         num_boost_round=5)
+    b_dp = lgb.train({**params, "tree_learner": "data"},
+                     lgb.Dataset(X, label=y), num_boost_round=5)
+    assert b_dp._dp_mesh is not None, "DP path must engage"
+    ps, pd = b_serial.predict(X[:256]), b_dp.predict(X[:256])
+    np.testing.assert_allclose(ps, pd, rtol=5e-4, atol=5e-5)
