@@ -55,8 +55,17 @@ __all__ = [
 
 
 def __getattr__(name):
-    # sklearn-style estimators and plotting are imported lazily to keep
-    # `import lightgbm_tpu` light.
+    # sklearn-style estimators, plotting, and the serving runtime are
+    # imported lazily to keep `import lightgbm_tpu` light.
+    if name == "serving":
+        from . import serving
+
+        return serving
+    if name in ("PackedForest", "PredictorRuntime", "MicroBatcher",
+                "pack_booster"):
+        from . import serving
+
+        return getattr(serving, name)
     if name in ("LGBMRegressor", "LGBMClassifier", "LGBMRanker", "LGBMModel",
                 "LGBMRandomForestRegressor"):
         from . import sklearn as _sk

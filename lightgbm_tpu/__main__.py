@@ -16,6 +16,20 @@ Config format (upstream io/config semantics): one ``key = value`` per line,
 Data files are CSV/TSV (auto-sniffed) with ``label_column=<int>`` (default
 0, upstream default) or ``label_column=name:<col>``; ``header=true|false``
 (default false, matching upstream).
+
+``task=serve`` (alias ``predict-server``) is the serving front end: it
+loads a model (JSON text or packed ``.npz``), builds the compiled
+PredictorRuntime + micro-batching queue (lightgbm_tpu.serving), and
+serves newline-delimited requests from stdin to stdout — one CSV row (or
+JSON array) of features in, one prediction out, no network dependency:
+
+    python -m lightgbm_tpu task=serve input_model=model.npz \
+        max_batch=256 max_delay_ms=2 < requests.csv > preds.txt
+
+Keys: ``output_format=csv|json`` (csv), ``raw_score=true|false`` (false),
+``num_iteration`` (staged truncation), ``request_timeout_ms`` (per-request
+queue deadline), ``show_stats=true`` (serving counters as JSON on stderr
+at shutdown), ``max_bucket``/``max_cache_entries`` (runtime knobs).
 """
 
 from __future__ import annotations
@@ -131,7 +145,115 @@ def main(argv: Optional[List[str]] = None) -> int:
         np.savetxt(output_result, pred, fmt="%.10g")
         print(f"[lightgbm_tpu] predictions -> {output_result}")
         return 0
-    raise SystemExit(f"unknown task {task!r} (train|predict)")
+    if task in ("serve", "predict-server"):
+        if input_model is None:
+            raise SystemExit(
+                "task=serve requires input_model=<model.txt|model.npz>")
+        return _serve(input_model, cfg)
+    raise SystemExit(f"unknown task {task!r} (train|predict|serve)")
+
+
+def _parse_request_line(line: str) -> Optional[np.ndarray]:
+    """One request: CSV floats or a JSON array; blank/comment -> None."""
+    line = line.strip()
+    if not line or line.startswith("#"):
+        return None
+    if line.startswith("["):
+        import json
+
+        return np.asarray(json.loads(line), dtype=np.float64)
+    return np.asarray(
+        [np.nan if c.strip() in ("", "NA", "na", "NaN") else float(c)
+         for c in line.split(",")], dtype=np.float64)
+
+
+def _serve(input_model: str, cfg: Dict[str, str],
+           stdin=None, stdout=None, stderr=None) -> int:
+    """Micro-batched stdin/stdout serving loop (no network dependency).
+
+    Reads one request per line, coalesces through MicroBatcher, answers
+    in submission order.  Separated from main() with injectable streams
+    so the loop is Tier-1-testable in-process.
+    """
+    import json
+
+    from .serving import MicroBatcher, PackedForest, PredictorRuntime
+    from .serving.packed import pack_booster
+
+    stdin = sys.stdin if stdin is None else stdin
+    stdout = sys.stdout if stdout is None else stdout
+    stderr = sys.stderr if stderr is None else stderr
+
+    def flag(key: str, default: bool = False) -> bool:
+        return cfg.pop(key, str(default)).lower() in ("true", "1", "yes")
+
+    max_batch = int(cfg.pop("max_batch", "128"))
+    max_delay_ms = float(cfg.pop("max_delay_ms", "2"))
+    max_bucket = int(cfg.pop("max_bucket", "16384"))
+    max_cache = int(cfg.pop("max_cache_entries", "12"))
+    out_format = cfg.pop("output_format", "csv")
+    raw_score = flag("raw_score")
+    show_stats = flag("show_stats")
+    tmo = cfg.pop("request_timeout_ms", None)
+    timeout_ms = None if tmo is None else float(tmo)
+    num_it = cfg.pop("num_iteration", None)
+    num_iteration = None if num_it is None else int(num_it)
+
+    if input_model.endswith(".npz"):
+        packed = PackedForest.load(input_model)   # validates on ingest
+    else:
+        import lightgbm_tpu as lgb
+
+        packed = pack_booster(lgb.Booster(model_file=input_model))
+    runtime = PredictorRuntime(packed, max_bucket=max_bucket,
+                               max_cache_entries=max_cache)
+    batcher = MicroBatcher(runtime, max_batch=max_batch,
+                           max_delay_ms=max_delay_ms,
+                           timeout_ms=timeout_ms, raw_score=raw_score)
+
+    def emit(pending) -> None:
+        try:
+            v = pending.result()
+        except Exception as e:                    # noqa: BLE001
+            stdout.write(f"ERROR: {type(e).__name__}: {e}\n")
+            return
+        v = np.atleast_1d(np.asarray(v, np.float64))
+        if out_format == "json":
+            stdout.write(json.dumps(
+                v.tolist() if v.size > 1 else float(v[0])) + "\n")
+        else:
+            stdout.write(",".join(f"{x:.10g}" for x in v) + "\n")
+
+    pendings = []
+    for line in stdin:
+        try:
+            row = _parse_request_line(line)
+        except (ValueError, json.JSONDecodeError) as e:
+            pendings.append(_failed_pending(e))
+            continue
+        if row is None:
+            continue
+        pendings.append(batcher.submit(row, num_iteration=num_iteration))
+        batcher.pump()
+        # stream out everything already resolved, in order
+        while pendings and pendings[0].done:
+            emit(pendings.pop(0))
+    batcher.flush()
+    for p in pendings:
+        emit(p)
+    stdout.flush()
+    if show_stats:
+        stderr.write(json.dumps(runtime.stats.snapshot()) + "\n")
+        stderr.flush()
+    return 0
+
+
+def _failed_pending(e: Exception):
+    from .serving import PendingPrediction
+
+    p = PendingPrediction()
+    p._set(error=e)
+    return p
 
 
 if __name__ == "__main__":

@@ -326,6 +326,17 @@ class BinMapper:
             return float(ub[bin_idx])
         return float("inf")
 
+    # -- persistence glue (single JSON schema shared by the model file and
+    # the packed serving artifact — utils.serialize owns the layout) -------
+    def to_dict(self) -> dict:
+        from .utils.serialize import mapper_to_dict
+        return mapper_to_dict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "BinMapper":
+        from .utils.serialize import mapper_from_dict
+        return mapper_from_dict(d)
+
 
 def _to_2d_float_array(data: Any) -> np.ndarray:
     """Accept numpy / pandas / list-of-lists; return f64 ndarray [n, F]."""

@@ -439,7 +439,23 @@ def grow_tree(
         pass counts — see :func:`_exact_prune`);
       * otherwise — "half" tail (near-strict tail ordering).
     """
+    raw_wave_width = wave_width
     wave_width, decoded_tail, overgrow_leaves = decode_wave_width(wave_width)
+    if decoded_tail == "exact" and (
+            wave_width > 512 or overgrow_leaves <= num_leaves):
+        # ints >= 1024 are RESERVED for resolve_wave_width's exact-tail
+        # encoding (overgrow_leaves * 1024 + width, width <= 512, overgrow
+        # strictly past num_leaves).  A direct caller passing a genuine
+        # width (e.g. 2000) would otherwise be silently misrouted into
+        # exact mode with a nonsense overgrow target (ADVICE r5) — reject
+        # it instead; widths beyond 512 are past the MXU tile sweet spot
+        # and are clamped by the encoder anyway.
+        raise ValueError(
+            f"wave_width={raw_wave_width} decodes to exact-tail "
+            f"(width={wave_width}, overgrow_leaves={overgrow_leaves}) but "
+            f"is not a valid resolve_wave_width encoding for "
+            f"num_leaves={num_leaves}; raw widths must be < 1024 — use "
+            "gbdt.resolve_wave_width to encode the exact tail")
     if decoded_tail != "half" or wave_tail == "half":
         wave_tail = decoded_tail
     if wave_width > 1 and not (fp_axis is not None and cat_info is not None):

@@ -405,9 +405,14 @@ def hist_fused_pallas(
 
 
 def _fused_part_kernel(bins_ref, stats_ref, pv_ref, out_ref, enc_ref, *,
-                       num_features: int, num_bins: int, num_segments: int,
-                       hist_dtype: str):
+                       num_features: int, num_bins: int, num_segments: int):
     """Wave histogram + ROW PARTITION in one kernel (single f-block).
+
+    Accumulation is ALWAYS bf16-dot into f32 here; f32-exact callers get
+    it via the caller-side hi/lo split (two whole-kernel passes over this
+    same single-dot body — see hist_partition_fused_pallas).  There is
+    deliberately no in-kernel dtype knob (ADVICE r5: the old dead
+    ``hist_dtype`` parameter implied one existed).
 
     The r5 trace at Higgs-11M showed ~22 ms/wave of XLA-side partition
     work around a ~117 ms kernel: an [n, F] lane-reduction to pick each
@@ -540,8 +545,7 @@ def hist_partition_fused_pallas(
             functools.partial(_fused_part_kernel,
                               num_features=num_features,
                               num_bins=num_bins,
-                              num_segments=num_segments,
-                              hist_dtype="bf16"),
+                              num_segments=num_segments),
             grid=(n_chunks,),
             in_specs=[
                 pl.BlockSpec((num_features, chunk), lambda c: (0, c),

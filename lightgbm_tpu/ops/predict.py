@@ -39,7 +39,13 @@ def predict_tree_binned(tree, bins: jnp.ndarray,
         exactly the tree's ACTUAL depth (wave-grown trees are usually
         ~10 deep where num_leaves-1 would be 126 scan steps; an
         optimistic static bound is UNSOUND because wave growth can stall
-        to one split per wave — code review r5).
+        to one split per wave — code review r5).  The convergence loop is
+        additionally bounded by node capacity: any valid path visits each
+        node at most once, so a tree that has not converged after
+        ``capacity`` steps is malformed (cycle / dangling children — e.g.
+        an untrusted loaded model) and traversal stops instead of hanging
+        (ADVICE r5; the serving ingest validator rejects such trees with
+        an error before they ever reach traversal).
 
     Returns f32 [n] raw leaf values (no shrinkage applied).
     """
@@ -59,9 +65,11 @@ def predict_tree_binned(tree, bins: jnp.ndarray,
 
     node0 = jnp.zeros(n, dtype=jnp.int32)
     if max_depth_cap is None:
-        node = lax.while_loop(
-            lambda nd: jnp.any(~tree.is_leaf[nd]),
-            advance, node0)
+        capacity = tree.is_leaf.shape[-1]
+        node, _ = lax.while_loop(
+            lambda c: jnp.any(~tree.is_leaf[c[0]]) & (c[1] < capacity),
+            lambda c: (advance(c[0]), c[1] + 1),
+            (node0, jnp.int32(0)))
     else:
         node, _ = lax.scan(lambda nd, _: (advance(nd), None), node0, None,
                            length=max_depth_cap)

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils.compat import shard_map
+
 from ..models.gbdt import HyperScalars, _rebuild_objective
 from ..ops.lookup import lookup_values
 from ..models.tree import Tree, grow_tree
@@ -180,7 +182,7 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
         new_pred = pred + shrink * lookup_values(row_leaf, tree.leaf_value)
         return tree, new_pred
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step_mc if num_class > 1 else step,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
@@ -235,7 +237,7 @@ def make_dp_linear_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
         new_pred = pred + hyper.learning_rate * delta
         return tree, new_pred
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
@@ -273,7 +275,7 @@ def make_dp_grow_step(mesh: Mesh, num_leaves: int, num_bins: int,
             wave_width=wave_width, fuse_partition=True)
         return tree, row_leaf
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(), P()),

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils.compat import shard_map
+
 from ..models.gbdt import HyperScalars, _rebuild_objective
 from ..ops.lookup import lookup_values
 from ..models.tree import grow_tree
@@ -130,7 +132,7 @@ def make_fp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
         new_pred = pred + shrink * lookup_values(row_leaf, tree.leaf_value)
         return tree, new_pred
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step,
         mesh=mesh,
         in_specs=(P(None, FEATURE_AXIS), P(), P(), P(), P(),
@@ -197,7 +199,7 @@ def make_dp_fp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
         new_pred = pred_l + shrink * lookup_values(row_leaf, tree.leaf_value)
         return tree, new_pred
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step,
         mesh=mesh,
         in_specs=(P("data", FEATURE_AXIS), P("data"), P("data"), P("data"),

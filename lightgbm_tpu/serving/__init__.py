@@ -1,0 +1,39 @@
+"""lightgbm_tpu.serving — compiled batch-inference runtime.
+
+Turns a trained/loaded Booster into a standalone serving artifact and
+drives it at high throughput:
+
+    from lightgbm_tpu.serving import pack_booster, PredictorRuntime
+
+    packed = pack_booster(booster)            # SoA tensor stack + bin bounds
+    packed.save("model.npz")                  # versioned serving artifact
+
+    rt = PredictorRuntime(PackedForest.load("model.npz"))
+    preds = rt.predict(X)                     # bucketed, compile-cached
+
+    batcher = MicroBatcher(rt, max_batch=256, max_delay_ms=2.0)
+    handle = batcher.submit(row); batcher.pump(); handle.result()
+
+See packed.py (format + ingest validation), runtime.py (shape-bucketed
+compile cache), queue.py (micro-batching), stats.py (counters).  The CLI
+front end is ``python -m lightgbm_tpu task=serve input_model=...``.
+"""
+
+from .packed import (PACKED_FORMAT_VERSION, PackedForest, PackedForestError,
+                     pack_booster)
+from .queue import MicroBatcher, PendingPrediction, RequestTimeout
+from .runtime import PredictorRuntime, bucket_for
+from .stats import ServingStats
+
+__all__ = [
+    "MicroBatcher",
+    "PACKED_FORMAT_VERSION",
+    "PackedForest",
+    "PackedForestError",
+    "PendingPrediction",
+    "PredictorRuntime",
+    "RequestTimeout",
+    "ServingStats",
+    "bucket_for",
+    "pack_booster",
+]

@@ -1,0 +1,217 @@
+"""Micro-batching front end: coalesce single-row requests into one dispatch.
+
+Serving traffic arrives one request at a time, but the device wants full
+buckets: dispatching rows individually pays one program invocation (and
+one bucket-1 dispatch) per row.  The MicroBatcher accumulates requests
+until ``max_batch`` are waiting OR the oldest has waited ``max_delay_ms``,
+then coalesces them into ONE runtime dispatch and fans the results back
+out to the per-request handles.
+
+Design constraints (Tier-1 testability):
+
+* **No wall-clock dependence** — the time source is injectable
+  (``clock=``), so tests drive coalescing and timeout behavior with a
+  mocked clock and zero sleeps.  ``pump()`` is the explicit scheduler
+  step; a driver loop (the CLI, or a thread the embedder owns) calls it
+  after submissions and on its idle ticks.
+* **Per-request deadlines** — a request older than its ``timeout_ms``
+  is expired with :class:`RequestTimeout` instead of being dispatched.
+* **Graceful degradation** — when the batched device dispatch raises,
+  the batch falls back to the pure-numpy unbatched predictor
+  (``PackedForest.predict_numpy``) per request, so an XLA/device failure
+  degrades throughput instead of erroring the traffic.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+
+class RequestTimeout(Exception):
+    """The request expired in the queue before a dispatch picked it up."""
+
+
+class PendingPrediction:
+    """Handle for one submitted row; filled in by a later pump()."""
+
+    __slots__ = ("value", "error", "done")
+
+    def __init__(self):
+        self.value = None
+        self.error: Optional[Exception] = None
+        self.done = False
+
+    def result(self):
+        if not self.done:
+            raise RuntimeError(
+                "prediction not ready — drive MicroBatcher.pump()/flush()")
+        if self.error is not None:
+            raise self.error
+        return self.value
+
+    def _set(self, value=None, error: Optional[Exception] = None) -> None:
+        self.value = value
+        self.error = error
+        self.done = True
+
+
+class _QueuedRequest:
+    __slots__ = ("row", "pending", "enqueued_at", "deadline", "num_iteration")
+
+    def __init__(self, row, pending, enqueued_at, deadline, num_iteration):
+        self.row = row
+        self.pending = pending
+        self.enqueued_at = enqueued_at
+        self.deadline = deadline          # absolute clock time or None
+        self.num_iteration = num_iteration
+
+
+class MicroBatcher:
+    """Coalesce rows into bucket-sized runtime dispatches.
+
+    Args:
+      runtime: a PredictorRuntime.
+      max_batch: dispatch as soon as this many requests are queued.
+      max_delay_ms: dispatch once the OLDEST queued request has waited
+        this long, even if the batch is short.
+      timeout_ms: default per-request deadline (None = no deadline).
+      clock: monotonic time source, injectable for tests.
+      raw_score: serve raw scores instead of transformed predictions.
+      fallback_unbatched: on device-dispatch error, retry each request
+        through the numpy predictor instead of failing the batch.
+    """
+
+    def __init__(self, runtime, max_batch: int = 128,
+                 max_delay_ms: float = 5.0,
+                 timeout_ms: Optional[float] = None,
+                 clock=time.monotonic,
+                 raw_score: bool = False,
+                 fallback_unbatched: bool = True):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.runtime = runtime
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_ms) / 1e3
+        self.timeout_ms = timeout_ms
+        self.clock = clock
+        self.raw_score = bool(raw_score)
+        self.fallback_unbatched = bool(fallback_unbatched)
+        self.stats = runtime.stats
+        self._q: "deque[_QueuedRequest]" = deque()
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, row, timeout_ms: Optional[float] = None,
+               num_iteration: Optional[int] = None) -> PendingPrediction:
+        """Queue one feature row; returns its handle (resolved by pump)."""
+        row = np.asarray(row, np.float64).reshape(-1)
+        nf = self.runtime.packed.num_feature()
+        pending = PendingPrediction()
+        if row.shape[0] != nf:
+            pending._set(error=ValueError(
+                f"row has {row.shape[0]} features, model expects {nf}"))
+            return pending
+        now = self.clock()
+        tmo = self.timeout_ms if timeout_ms is None else timeout_ms
+        deadline = None if tmo is None else now + float(tmo) / 1e3
+        self._q.append(_QueuedRequest(row, pending, now, deadline,
+                                      num_iteration))
+        self.stats.record_request()
+        return pending
+
+    def pending_count(self) -> int:
+        return len(self._q)
+
+    # -- scheduling ----------------------------------------------------------
+    def pump(self) -> int:
+        """One scheduler step: expire overdue requests, dispatch due
+        batches.  Returns the number of batches dispatched."""
+        now = self.clock()
+        self._expire(now)
+        dispatched = 0
+        # full batches always go, regardless of delay
+        while len(self._q) >= self.max_batch:
+            self._dispatch(self._take(self.max_batch), now)
+            dispatched += 1
+        # short batch goes once the oldest request has waited long enough
+        if self._q and (now - self._q[0].enqueued_at) >= self.max_delay_s:
+            self._dispatch(self._take(len(self._q)), now)
+            dispatched += 1
+        return dispatched
+
+    def flush(self) -> int:
+        """Dispatch everything still queued (shutdown / end-of-stream)."""
+        now = self.clock()
+        self._expire(now)
+        dispatched = 0
+        while self._q:
+            self._dispatch(self._take(min(len(self._q), self.max_batch)),
+                           now)
+            dispatched += 1
+        return dispatched
+
+    # -- internals -----------------------------------------------------------
+    def _take(self, k: int):
+        return [self._q.popleft() for _ in range(k)]
+
+    def _expire(self, now: float) -> None:
+        # deadlines are monotone only per-request, so scan the whole queue
+        # (bounded by max_batch in steady state)
+        keep = deque()
+        expired = 0
+        while self._q:
+            r = self._q.popleft()
+            if r.deadline is not None and now > r.deadline:
+                r.pending._set(error=RequestTimeout(
+                    f"request expired after "
+                    f"{(now - r.enqueued_at) * 1e3:.1f} ms in queue"))
+                expired += 1
+            else:
+                keep.append(r)
+        self._q = keep
+        if expired:
+            self.stats.record_timeout(expired)
+
+    def _dispatch(self, batch, now: float) -> None:
+        if not batch:
+            return
+        # requests sharing a truncation setting coalesce; mixed settings
+        # split into sub-batches (rare — serving traffic is homogeneous)
+        by_k = {}
+        for r in batch:
+            by_k.setdefault(r.num_iteration, []).append(r)
+        for num_it, group in by_k.items():
+            X = np.stack([r.row for r in group])
+            self.stats.record_batch(
+                queue_latency_s=max(0.0, now - group[0].enqueued_at))
+            try:
+                preds = self.runtime.predict(X, num_iteration=num_it,
+                                             raw_score=self.raw_score)
+            except Exception:
+                self._fallback(group, num_it)
+                continue
+            for i, r in enumerate(group):
+                r.pending._set(value=preds[i])
+
+    def _fallback(self, group, num_it) -> None:
+        """Device dispatch failed: unbatched CPU predict per request."""
+        if not self.fallback_unbatched:
+            for r in group:
+                r.pending._set(error=RuntimeError(
+                    "batched device dispatch failed and fallback is "
+                    "disabled"))
+            return
+        packed = self.runtime.packed
+        mapper = packed.bin_mapper
+        self.stats.record_fallback(len(group))
+        for r in group:
+            try:
+                codes = mapper.transform(r.row[None, :])
+                out = packed.predict_numpy(codes, num_iteration=num_it,
+                                           raw_score=self.raw_score)
+                r.pending._set(value=out[0])
+            except Exception as e:               # noqa: BLE001
+                r.pending._set(error=e)

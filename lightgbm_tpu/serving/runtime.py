@@ -1,0 +1,199 @@
+"""PredictorRuntime — compiled batch inference over a PackedForest.
+
+The training-side predictor (Booster.predict) retraces for every new batch
+shape: a traffic mix of 1000 distinct batch sizes means 1000 XLA compiles.
+The serving runtime instead:
+
+* rounds every incoming batch UP to a power-of-two bucket and pads with
+  masked rows, so the whole size range [1, max_bucket] shares
+  ``log2(max_bucket) + 1`` compiled programs;
+* keeps the compiled predict callables in a bounded LRU keyed by
+  ``(bucket, raw_score)`` — the ``ntree_limit`` truncation mask is a
+  TRACED argument of every program (the repo's staged-predict contract),
+  so changing it never recompiles and never grows the key space;
+* donates the padded input buffer to the program on TPU (the binned batch
+  is dead after dispatch, so XLA can reuse its pages for the output);
+* performs the raw->binned transform on the edge with the packed bin
+  bounds (the same dataset.BinMapper search the trainer used, so serving
+  and training binning can never diverge);
+* batches larger than ``max_bucket`` stream through in full-bucket chunks.
+
+Per-bucket counters (requests, dispatches, cache hits/misses, padding
+waste, latency quantiles) land in :class:`serving.stats.ServingStats`.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from .packed import PackedForest
+from .stats import ServingStats
+
+DEFAULT_MAX_BUCKET = 1 << 14          # 16384-row dispatches
+DEFAULT_CACHE_ENTRIES = 12
+
+
+def bucket_for(n: int, max_bucket: int) -> int:
+    """Smallest power-of-two >= n, capped at max_bucket."""
+    if n <= 1:
+        return 1
+    return min(1 << (int(n - 1).bit_length()), max_bucket)
+
+
+class PredictorRuntime:
+    """Serve a packed forest at fixed shapes with a bounded compile cache.
+
+    Args:
+      packed: a validated PackedForest (``PackedForest.load`` validates).
+      max_bucket: largest single-dispatch row count (power of two);
+        bigger batches are chunked.
+      max_cache_entries: LRU bound on live compiled programs.  Eviction
+        drops the jitted callable, so a re-used evicted bucket recompiles.
+      donate: donate the padded input buffer to XLA; default on for TPU
+        backends only (CPU donation is a no-op that warns).
+    """
+
+    def __init__(self, packed: PackedForest,
+                 max_bucket: int = DEFAULT_MAX_BUCKET,
+                 max_cache_entries: int = DEFAULT_CACHE_ENTRIES,
+                 donate: Optional[bool] = None,
+                 stats: Optional[ServingStats] = None):
+        import jax
+
+        if max_bucket < 1 or (max_bucket & (max_bucket - 1)):
+            raise ValueError(f"max_bucket must be a power of two, got "
+                             f"{max_bucket}")
+        self.packed = packed
+        self.max_bucket = int(max_bucket)
+        self.max_cache_entries = int(max_cache_entries)
+        self.stats = stats if stats is not None else ServingStats()
+        self._donate = (jax.default_backend() == "tpu"
+                        if donate is None else bool(donate))
+        self._forest = packed.to_tree()           # device-resident once
+        self._obj = packed._objective()
+        self._cache: "OrderedDict[tuple, object]" = OrderedDict()
+        self.num_compiles = 0                      # lifetime program builds
+        self.buckets = [1 << i
+                        for i in range(self.max_bucket.bit_length())]
+
+    # -- public API ----------------------------------------------------------
+    def predict(self, data, num_iteration: Optional[int] = None,
+                raw_score: bool = False) -> np.ndarray:
+        """Predict on RAW features (binned on the edge, then dispatched)."""
+        from ..dataset import _to_2d_float_array
+
+        X = _to_2d_float_array(data)
+        codes = self.packed.bin_mapper.transform(X)
+        return self.predict_binned(codes, num_iteration=num_iteration,
+                                   raw_score=raw_score)
+
+    def predict_binned(self, codes: np.ndarray,
+                       num_iteration: Optional[int] = None,
+                       raw_score: bool = False) -> np.ndarray:
+        """Predict on pre-binned codes (uint8/int [n, F])."""
+        k = self.packed._resolve_k(num_iteration)
+        n = codes.shape[0]
+        if n == 0:
+            width = (self.packed.num_class,) if self.packed.num_class > 1 \
+                else ()
+            return np.zeros((0,) + width, np.float32)
+        outs = []
+        for lo in range(0, n, self.max_bucket):
+            outs.append(self._dispatch(codes[lo:lo + self.max_bucket], k,
+                                       raw_score))
+        return outs[0] if len(outs) == 1 else np.concatenate(outs)
+
+    def cache_info(self) -> dict:
+        return {
+            "entries": len(self._cache),
+            "max_entries": self.max_cache_entries,
+            "num_compiles": self.num_compiles,
+            "keys": [list(map(str, k)) for k in self._cache.keys()],
+        }
+
+    # -- internals -----------------------------------------------------------
+    def _dispatch(self, codes: np.ndarray, k: int,
+                  raw_score: bool) -> np.ndarray:
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        n = codes.shape[0]
+        bucket = bucket_for(n, self.max_bucket)
+        pad = bucket - n
+        if pad:
+            codes = np.concatenate(
+                [codes, np.zeros((pad, codes.shape[1]), codes.dtype)])
+        mask = np.zeros(bucket, np.float32)
+        mask[:n] = 1.0
+        fn = self._get_fn(bucket, raw_score)
+        out = np.asarray(fn(jnp.asarray(codes), jnp.asarray(mask),
+                            jnp.int32(k)))
+        self.stats.record_dispatch(
+            bucket, rows=n, padded=pad,
+            latency_s=time.perf_counter() - t0)
+        return out[:n]
+
+    def _get_fn(self, bucket: int, raw_score: bool):
+        key = (bucket, bool(raw_score))
+        fn = self._cache.get(key)
+        if fn is not None:
+            self._cache.move_to_end(key)
+            self.stats.record_cache(bucket, hit=True)
+            return fn
+        self.stats.record_cache(bucket, hit=False)
+        fn = self._build_fn(raw_score)
+        self.num_compiles += 1
+        self._cache[key] = fn
+        while len(self._cache) > self.max_cache_entries:
+            self._cache.popitem(last=False)        # evict LRU
+        return fn
+
+    def _build_fn(self, raw_score: bool):
+        """One jitted fixed-shape predict program.
+
+        ``num_iteration`` is traced (the forest replay masks rounds on
+        device), so every staged-prediction variant shares this program.
+        Padded rows are valid bin codes (zeros) that traverse normally;
+        the row mask zeroes their outputs so no padding garbage escapes,
+        and for probability transforms the masked rows are neutralized
+        BEFORE the transform would see them downstream.
+        """
+        import jax
+        import jax.numpy as jnp
+        from ..ops.predict import predict_forest_binned
+
+        packed = self.packed
+        forest = self._forest
+        obj = self._obj
+        nc = packed.num_class
+        shrink = jnp.float32(packed.shrink)
+        inits = np.asarray(packed.init_score, np.float32)
+        depth_cap = packed.depth_cap
+        is_rf = packed.params.get("boosting") == "rf"
+
+        def fn(bins, mask, num_it):
+            if nc > 1:
+                cols = [predict_forest_binned(
+                    jax.tree.map(lambda a, c=c: a[:, c], forest), bins,
+                    shrink, float(inits[c]), num_it, depth_cap)
+                    for c in range(nc)]
+                raw = jnp.stack(cols, axis=1)                    # [n, K]
+                if is_rf:
+                    raw = ((raw - inits[None, :])
+                           / jnp.maximum(num_it, 1) + inits[None, :])
+                out = raw if raw_score else obj.transform(raw)
+                return out * mask[:, None]
+            raw = predict_forest_binned(
+                forest, bins, shrink, float(inits[0]), num_it, depth_cap)
+            if is_rf:
+                raw = ((raw - inits[0]) / jnp.maximum(num_it, 1)
+                       + inits[0])
+            out = raw if raw_score else obj.transform(raw)
+            return out * mask
+
+        donate = (0,) if self._donate else ()
+        return jax.jit(fn, donate_argnums=donate)

@@ -1,0 +1,122 @@
+"""Serving counters: per-bucket traffic, compile-cache, padding, latency.
+
+Kept deliberately free of JAX and of the runtime itself so the queue, the
+runtime, and the CLI can all write into one ServingStats and a snapshot
+is a plain JSON-able dict (the ``lightgbm_tpu serve`` subcommand prints
+it on shutdown; tools/bench_serving.py embeds it in its artifact).
+
+Latency quantiles come from a bounded per-bucket reservoir (last
+``RESERVOIR`` dispatch latencies) — enough for p50/p99 at serving
+cadence without unbounded memory.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, Optional
+
+RESERVOIR = 2048
+
+
+def _quantile(values, q: float) -> Optional[float]:
+    if not values:
+        return None
+    s = sorted(values)
+    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return float(s[idx])
+
+
+class _BucketStats:
+    __slots__ = ("rows", "dispatches", "cache_hits", "cache_misses",
+                 "padded_rows", "latencies")
+
+    def __init__(self):
+        self.rows = 0               # real (unpadded) rows served
+        self.dispatches = 0         # device program invocations
+        self.cache_hits = 0         # compiled-program LRU hits
+        self.cache_misses = 0       # LRU misses (each one is a compile)
+        self.padded_rows = 0        # wasted rows from bucket rounding
+        self.latencies = deque(maxlen=RESERVOIR)
+
+    def snapshot(self, bucket: int) -> dict:
+        total = self.rows + self.padded_rows
+        return {
+            "bucket": bucket,
+            "rows": self.rows,
+            "dispatches": self.dispatches,
+            "cache_hits": self.cache_hits,
+            "cache_misses": self.cache_misses,
+            "padded_rows": self.padded_rows,
+            "padding_waste": (self.padded_rows / total if total else 0.0),
+            "latency_p50_ms": _ms(_quantile(self.latencies, 0.50)),
+            "latency_p99_ms": _ms(_quantile(self.latencies, 0.99)),
+        }
+
+
+def _ms(v: Optional[float]) -> Optional[float]:
+    return None if v is None else v * 1e3
+
+
+class ServingStats:
+    """Aggregates serving counters; all methods are cheap and allocation-
+    light (hot-path safe).  Not thread-safe by itself — the micro-batch
+    queue serializes writers."""
+
+    def __init__(self):
+        self._buckets: Dict[int, _BucketStats] = {}
+        self.requests = 0            # queue-level submitted requests
+        self.batched_dispatches = 0  # queue-level coalesced dispatches
+        self.timeouts = 0            # requests expired before dispatch
+        self.fallbacks = 0           # graceful-degradation CPU predicts
+        self.queue_latencies = deque(maxlen=RESERVOIR)
+
+    def _b(self, bucket: int) -> _BucketStats:
+        bs = self._buckets.get(bucket)
+        if bs is None:
+            bs = self._buckets[bucket] = _BucketStats()
+        return bs
+
+    # -- runtime-side ------------------------------------------------------
+    def record_dispatch(self, bucket: int, rows: int, padded: int,
+                        latency_s: float) -> None:
+        bs = self._b(bucket)
+        bs.rows += rows
+        bs.dispatches += 1
+        bs.padded_rows += padded
+        bs.latencies.append(latency_s)
+
+    def record_cache(self, bucket: int, hit: bool) -> None:
+        bs = self._b(bucket)
+        if hit:
+            bs.cache_hits += 1
+        else:
+            bs.cache_misses += 1
+
+    # -- queue-side --------------------------------------------------------
+    def record_request(self, n: int = 1) -> None:
+        self.requests += n
+
+    def record_batch(self, queue_latency_s: float) -> None:
+        self.batched_dispatches += 1
+        self.queue_latencies.append(queue_latency_s)
+
+    def record_timeout(self, n: int = 1) -> None:
+        self.timeouts += n
+
+    def record_fallback(self, n: int = 1) -> None:
+        self.fallbacks += n
+
+    # -- reporting ---------------------------------------------------------
+    def snapshot(self) -> dict:
+        return {
+            "requests": self.requests,
+            "batched_dispatches": self.batched_dispatches,
+            "timeouts": self.timeouts,
+            "fallbacks": self.fallbacks,
+            "queue_latency_p50_ms": _ms(_quantile(self.queue_latencies,
+                                                  0.50)),
+            "queue_latency_p99_ms": _ms(_quantile(self.queue_latencies,
+                                                  0.99)),
+            "buckets": [self._buckets[b].snapshot(b)
+                        for b in sorted(self._buckets)],
+        }

@@ -1,0 +1,22 @@
+"""JAX version compatibility shims.
+
+The mesh learners target the current ``jax.shard_map`` API
+(``check_vma=``), but 0.4.x installs only expose
+``jax.experimental.shard_map.shard_map`` with the older ``check_rep=``
+spelling.  Resolving through here keeps the call sites on the modern
+API while remaining runnable on both.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)

@@ -149,6 +149,14 @@ def booster_to_string(booster, num_iteration: Optional[int] = None,
 def save_booster(booster, filename: str,
                  num_iteration: Optional[int] = None,
                  start_iteration: int = 0) -> None:
+    if filename.endswith(".npz"):
+        # packed serving artifact (serving.packed): SoA tensor stack +
+        # bin bounds, validated on ingest — the production predict path
+        from ..serving.packed import pack_booster
+
+        pack_booster(booster, num_iteration=num_iteration,
+                     start_iteration=start_iteration).save(filename)
+        return
     with open(filename, "w") as f:
         f.write(booster_to_string(booster, num_iteration=num_iteration,
                                   start_iteration=start_iteration))
@@ -266,11 +274,15 @@ def dump_booster_dict(booster, num_iteration: Optional[int] = None,
 
 def load_booster_into(booster, model_file: Optional[str] = None,
                       model_str: Optional[str] = None) -> None:
-    """Populate a bare Booster instance from a saved model."""
+    """Populate a bare Booster instance from a saved model (JSON text or a
+    packed ``.npz`` serving artifact — the latter validates on ingest)."""
     import jax
     from ..config import parse_params
     from ..objectives import create_objective
 
+    if model_file is not None and model_file.endswith(".npz"):
+        _load_packed_into(booster, model_file)
+        return
     if model_str is None:
         with open(model_file) as f:
             model_str = f.read()
@@ -298,3 +310,61 @@ def load_booster_into(booster, model_file: Optional[str] = None,
     booster._key = jax.random.PRNGKey(booster.params.seed)
     booster._feature_names = doc.get("feature_names")
     booster._bin_mapper = mapper_from_dict(doc["bin_mapper"])
+
+
+def _load_packed_into(booster, path: str) -> None:
+    """Populate a bare Booster from a packed ``.npz`` serving artifact.
+
+    The packed loader already validated the forest structurally (child
+    ranges, acyclicity, closed leaves), so a crafted model file raises
+    PackedForestError here instead of hanging traversal later.  The packed
+    format is prediction-only: per-node counts and split gains are not
+    stored, so feature_importance on a packed-loaded booster is zeros.
+    """
+    import jax
+    import jax.numpy as jnp
+    from ..config import parse_params
+    from ..models.tree import Tree
+    from ..objectives import create_objective
+    from ..serving.packed import PackedForest
+
+    pf = PackedForest.load(path)
+    params_dict = {k: v for k, v in pf.params.items() if v is not None}
+    params_dict.pop("metric", None)
+    booster.params = parse_params(params_dict, warn_unknown=False)
+    booster.params.metric = pf.params.get("metric") or []
+    booster.obj = create_objective(booster.params)
+    booster.train_set = None
+    booster.init_score_ = (np.asarray(pf.init_score, np.float32)
+                           if pf.num_class > 1
+                           else float(pf.init_score[0]))
+
+    def per_round(a, t):
+        return None if a is None else jnp.asarray(a[t])
+
+    num_leaves = np.sum(pf.is_leaf, axis=-1).astype(np.int32)  # [T(,K)]
+    booster.trees = [
+        Tree(
+            split_feature=jnp.asarray(pf.split_feature[t], jnp.int32),
+            split_bin=jnp.asarray(pf.split_bin[t], jnp.int32),
+            left=jnp.asarray(pf.left[t], jnp.int32),
+            right=jnp.asarray(pf.right[t], jnp.int32),
+            leaf_value=jnp.asarray(pf.leaf_value[t], jnp.float32),
+            is_leaf=jnp.asarray(pf.is_leaf[t], bool),
+            count=jnp.zeros(pf.split_feature[t].shape, jnp.float32),
+            split_gain=jnp.zeros(pf.split_feature[t].shape, jnp.float32),
+            num_leaves=jnp.asarray(num_leaves[t], jnp.int32),
+            is_cat_split=per_round(pf.is_cat_split, t),
+            cat_mask=per_round(pf.cat_mask, t),
+        )
+        for t in range(pf.num_trees)]
+    booster.best_iteration = int(pf.best_iteration)
+    booster.best_score = {}
+    booster._valid = []
+    booster._forest_cache = None
+    booster._iter = len(booster.trees)
+    booster._pred_train = None
+    booster._bag = None
+    booster._key = jax.random.PRNGKey(booster.params.seed)
+    booster._feature_names = pf.feature_names
+    booster._bin_mapper = pf.bin_mapper
