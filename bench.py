@@ -659,6 +659,16 @@ def main() -> None:
     def remaining():
         return budget_s - (time.perf_counter() - t_start)
 
+    def reserved_cap(base, reserve, floor=120):
+        """Per-attempt timeout that leaves ``reserve`` seconds of the
+        global budget for the sections still queued behind this one.
+        The r5 self-run artifact recorded ``sweep_skipped: budget
+        exhausted (31s left)`` because each mid-list section could run
+        to its own full cap with nothing held back for the tail; a
+        capped-but-degraded measurement of THIS section beats a missing
+        measurement of the NEXT one."""
+        return int(min(base, max(remaining() - reserve, floor)))
+
     def section(label, fn_expr, timeout, retries=1):
         """One crash-isolated workload subprocess: a remote-worker fault
         (PERF.md known issue) costs one section, not the artifact.
@@ -736,8 +746,15 @@ def main() -> None:
     section("higgs_goss", ["bench_higgs_goss()",
                            "bench_higgs_goss(500_000, 60)"],
             int(min(420, max(remaining() * 0.25, 90))))
-    section("mslr", "bench_mslr()", 600)
-    section("criteo_efb", "bench_criteo_efb()", 600)
+    # r7 budgeting: mslr gets a reduced-round fallback tier (half the
+    # queries, half the rounds — the recorded keys state what ran), and
+    # every pre-sweep section's cap reserves the floor the tail needs:
+    # criteo ~120s + a parity tier ~150s + sweep >=90s + skip-check slack
+    section("mslr", ["bench_mslr()", "bench_mslr(500, n_rounds=25)"],
+            reserved_cap(600, 480))
+    section("criteo_efb", ["bench_criteo_efb()",
+                           "bench_criteo_efb(100_000, n_rounds=15)"],
+            reserved_cap(600, 330))
     # parity-preset corroboration (strict grower + exact f32 on the XLA
     # path); the smaller tiers keep the PAIRED gap apples-to-apples and
     # exist because strict-jnp training is exec-degradation-sensitive
@@ -747,8 +764,8 @@ def main() -> None:
     # instead of burning the section on 600 s timeouts (code review r5)
     section("higgs_parity", ["bench_higgs_parity_auc(1_000_000, 100)",
                              "bench_higgs_parity_auc(500_000, 100)",
-                             "bench_higgs_parity_auc(200_000, 100)"], 420,
-            retries=0)
+                             "bench_higgs_parity_auc(200_000, 100)"],
+            reserved_cap(420, 150), retries=0)
     # the sweep runs LAST and capped: it can only eat its own budget
     # (r4's artifact lost every north-star section to exactly this)
     sweep_cap = int(min(1200, max(remaining() - 60, 0)))

@@ -29,7 +29,9 @@ JSON array) of features in, one prediction out, no network dependency:
 Keys: ``output_format=csv|json`` (csv), ``raw_score=true|false`` (false),
 ``num_iteration`` (staged truncation), ``request_timeout_ms`` (per-request
 queue deadline), ``show_stats=true`` (serving counters as JSON on stderr
-at shutdown), ``max_bucket``/``max_cache_entries`` (runtime knobs).
+at shutdown), ``max_bucket``/``max_cache_entries`` (runtime knobs),
+``warm_buckets=true`` (precompile the bucket ladder before the first
+request so no size class pays its compile on live traffic).
 """
 
 from __future__ import annotations
@@ -194,6 +196,7 @@ def _serve(input_model: str, cfg: Dict[str, str],
     out_format = cfg.pop("output_format", "csv")
     raw_score = flag("raw_score")
     show_stats = flag("show_stats")
+    warm_buckets = flag("warm_buckets")
     tmo = cfg.pop("request_timeout_ms", None)
     timeout_ms = None if tmo is None else float(tmo)
     num_it = cfg.pop("num_iteration", None)
@@ -207,6 +210,13 @@ def _serve(input_model: str, cfg: Dict[str, str],
         packed = pack_booster(lgb.Booster(model_file=input_model))
     runtime = PredictorRuntime(packed, max_bucket=max_bucket,
                                max_cache_entries=max_cache)
+    if warm_buckets:
+        # precompile the bucket ladder before reading any request, so
+        # the first batch of each size class pays dispatch, not compile
+        n_warmed = runtime.warm(raw_score=raw_score)
+        stderr.write(f"[lightgbm_tpu] warmed {n_warmed} bucket "
+                     f"programs\n")
+        stderr.flush()
     batcher = MicroBatcher(runtime, max_batch=max_batch,
                            max_delay_ms=max_delay_ms,
                            timeout_ms=timeout_ms, raw_score=raw_score)

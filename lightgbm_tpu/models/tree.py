@@ -40,6 +40,12 @@ from ..ops.split import (
     leaf_output,
 )
 
+# tools/hlo_counts.py flips this to compile the fused strict grower with
+# the split-iteration kernel replaced by an optimization barrier, so the
+# CPU HLO counts only the XLA-side launches (the kernel is one TPU
+# custom-call but inlines under interpret mode).  Never set in production.
+_SPLIT_ITER_OPCOUNT_STUB = False
+
 
 class Tree(NamedTuple):
     """One tensorized decision tree (node arrays of length 2*num_leaves-1).
@@ -390,6 +396,7 @@ def grow_tree(
     ic_member=None,
     wave_tail: str = "half",
     fuse_partition: bool = False,
+    fuse_split: bool = True,
 ) -> Tuple[Tree, jnp.ndarray]:
     """Grow one best-first tree.
 
@@ -419,6 +426,13 @@ def grow_tree(
         feature's own used-bin range (``col_bins``).
       col_bins: optional i32 ``[F]`` per-training-column used-bin counts
         (BinMapper.n_bins / EFB col_bins) bounding the extra_trees draw.
+      fuse_split: run each strict split iteration as ONE Pallas call
+        (:func:`~lightgbm_tpu.ops.histogram_pallas.split_iter_pallas`:
+        cumsum gain scan + argmax + winner gather + packed-table update
+        in VMEM) instead of the ~49-fusion XLA body.  Engages only on
+        the plain numeric path (no categorical/monotone/extra-trees/
+        interaction/bynode-sampling/feature-parallel); numerics are
+        bitwise identical (tests/test_split_iter_fused.py).
 
     Returns:
       (Tree, row_leaf) — row_leaf gives each training row's final leaf node id
@@ -476,6 +490,21 @@ def grow_tree(
     if key is None:
         key = jax.random.PRNGKey(0)
     bynode_off = ff_bynode is None   # static: skip the per-node RNG draw
+
+    # Split-iteration mega-kernel gate (ops.histogram_pallas
+    # ._split_iter_kernel): the ~49-fusion tail of each split iteration —
+    # gain scan, argmax, winner gather, three node-table row writes, and
+    # the NEXT iteration's leaf pick — collapses into one pallas call.
+    # Static eligibility mirrors what the kernel traces: no categorical
+    # subset scan, no monotone bounds, no per-node RNG (bynode sampling /
+    # extra_trees), no interaction-constraint set recurrence, and no
+    # feature sharding (the winner must be globalized OUTSIDE the kernel).
+    # Numerics are bitwise identical to the XLA body by construction (the
+    # shared ops.split.split_gain_scan helper + first-occurrence argmax);
+    # ``fuse_split=False`` keeps the reference XLA body for debugging.
+    fuse_si = (fuse_split and cat_info is None and mono is None
+               and not extra_trees and ic_member is None and bynode_off
+               and fp_axis is None)
 
     def node_feature_mask(node_id):
         """Per-node column subsample drawn WITHIN the per-tree subset
@@ -548,6 +577,74 @@ def grow_tree(
     )
 
     bins_i32 = bins.astype(jnp.int32)
+
+    if fuse_si:
+        from ..ops.histogram_pallas import split_iter_pallas  # noqa: F401
+
+        f32 = jnp.float32
+        zero = jnp.float32(0.0)
+        # aux carries the pick the NEXT iteration acts on; the root pick
+        # reproduces iteration 0's argmax (only node 0 is a leaf, so the
+        # picked leaf is 0 and its gain is the root candidate's)
+        aux0 = jnp.stack([
+            zero, root_best.feature.astype(f32), root_best.bin.astype(f32),
+            jnp.isfinite(root_best.gain).astype(f32),
+            zero, zero, zero, zero]).reshape(1, 8)
+        fmask_row = feature_mask.astype(f32).reshape(1, num_features)
+        md_f = max_depth.astype(f32)
+
+        def body_f(_, carry):
+            P, row_leaf_c, n_nodes, n_leaves, aux = carry
+            leaf = aux[0, 0].astype(jnp.int32)
+            feat = aux[0, 1].astype(jnp.int32)
+            thr = aux[0, 2].astype(jnp.int32)
+            active = aux[0, 3] > 0
+            nl, nr = n_nodes, n_nodes + 1
+            # partition + segment select stay in XLA (they touch the [n]
+            # row axis); everything table-sized moves into the kernel
+            col = jnp.take(bins_i32, feat, axis=1)
+            go_left = col <= thr
+            new_rl = jnp.where(row_leaf_c == leaf,
+                               jnp.where(go_left, nl, nr), row_leaf_c)
+            row_leaf2 = jnp.where(active, new_rl, row_leaf_c)
+            seg = jnp.where(row_leaf2 == nl, 0,
+                            jnp.where(row_leaf2 == nr, 1, 2)).astype(
+                                jnp.int32)
+            hist2 = hist_fn(seg, 2)                      # [2, F, B, 3]
+            scal = jnp.stack([
+                jnp.asarray(ctx.lambda_l1, f32),
+                jnp.asarray(ctx.lambda_l2, f32),
+                jnp.asarray(ctx.min_data_in_leaf, f32),
+                jnp.asarray(ctx.min_sum_hessian, f32),
+                jnp.asarray(ctx.min_gain_to_split, f32),
+                jnp.asarray(ctx.max_delta_step, f32),
+                jnp.asarray(ctx.path_smooth, f32),
+                md_f, n_nodes.astype(f32),
+                zero, zero, zero, zero, zero, zero, zero]).reshape(1, 16)
+            if _SPLIT_ITER_OPCOUNT_STUB:
+                # op-count probe (tools/hlo_counts.py): swap the kernel
+                # for a pure_callback so a CPU compile shows the same
+                # launch structure a TPU build has — XLA-side fusions
+                # plus ONE custom-call (interpret mode would inline the
+                # kernel instead).  Compile-only; never executed.
+                P2, aux2 = jax.pure_callback(
+                    lambda h, p, a: (p, a),
+                    (jax.ShapeDtypeStruct(P.shape, P.dtype),
+                     jax.ShapeDtypeStruct(aux.shape, aux.dtype)),
+                    hist2.transpose(0, 1, 3, 2), P, aux,
+                    vmap_method="legacy_vectorized")
+            else:
+                P2, aux2 = split_iter_pallas(
+                    hist2.transpose(0, 1, 3, 2), P, fmask_row, aux, scal,
+                    pk=_PK)
+            grew = jnp.where(active, 1, 0).astype(jnp.int32)
+            return (P2, row_leaf2, n_nodes + 2 * grew, n_leaves + grew,
+                    aux2)
+
+        P_f, row_leaf_f, _, n_leaves_f, _ = lax.fori_loop(
+            0, num_leaves - 1, body_f,
+            (st.nodes, st.row_leaf, st.n_nodes, st.n_leaves, aux0))
+        return (_tree_from_packed(P_f, n_leaves_f, None, None), row_leaf_f)
 
     def body(_, st: _GrowState) -> _GrowState:
         P = st.nodes
@@ -893,11 +990,11 @@ def grow_tree_frontier(
     # reads data the kernel already holds in VMEM).  Static eligibility:
     # single-model growth (callers opt in; vmapped/batched growth keeps
     # the custom-vmap wide-segment route), no feature sharding, no
-    # categorical subset splits, a pallas-routed dtype, and the whole
-    # feature axis in one VMEM block (phase 1 selects each row's split
-    # feature from the resident bins tile).
-    from ..ops.histogram_pallas import partition_fusable
-
+    # categorical subset splits, and a pallas-routed dtype.  Since r7 the
+    # feature axis may span multiple VMEM blocks — routing then reads the
+    # wave-gathered split-feature code rows instead of the resident bins
+    # tile (_fused_part_kernel_mb), so MSLR-class shapes (F=136) get the
+    # in-kernel partition too.
     exact_dtype = hist_dtype == "f32x"
     route_pallas = (hist_impl == "pallas"
                     or (hist_impl == "auto" and not exact_dtype
@@ -908,8 +1005,7 @@ def grow_tree_frontier(
                  # the per-row field lookup runs at bf16 DEFAULT
                  # precision — every table value (feature id, bin,
                  # 2*rank child offset) must be an exact bf16 integer
-                 and max(num_features, 2 * w_width, num_bins) <= 256
-                 and partition_fusable(num_features, num_bins, w_width))
+                 and max(num_features, 2 * w_width, num_bins) <= 256)
     max_depth = jnp.asarray(max_depth, jnp.int32)
     neg_inf = jnp.float32(-jnp.inf)
     if key is None:
@@ -1089,7 +1185,11 @@ def grow_tree_frontier(
                 bins_t_prep, stats_t_prep, pv_t, w_width, num_bins,
                 part_chunk,
                 hist_dtype=("f32" if hist_dtype in ("f32", "f32x")
-                            else "bf16"))
+                            else "bf16"),
+                # multi-f-block routing gathers the wave split features'
+                # code rows; ignored on single-block shapes
+                wfeat=prow[:, K.CAND_FEAT].astype(jnp.int32),
+                num_features=num_features)
             direct_hist = histogram_psum(direct_hist, axis_name)
             enc = enc[:n]
             row_leaf = jnp.where(enc > 0, st.n_nodes + enc - 1, p)

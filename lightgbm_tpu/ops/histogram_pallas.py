@@ -404,6 +404,215 @@ def hist_fused_pallas(
     return out.transpose(2, 0, 1, 3)
 
 
+# ---------------------------------------------------------------------------
+# Split-iteration mega-kernel — the r7 kernel-count attack.
+#
+# PERF.md r4/r5: at fused-cv scale the strict grower's per-split iteration
+# lowered to ~49 XLA fusions + 1 custom-call, and with ~1,500 launches per
+# round at ~9 us each the sweep's floor is DISPATCH, not FLOPs.  Everything
+# between the histogram pass and the next iteration's partition is pure
+# VPU work over VMEM-sized operands ([2, F, 3, B] histograms + the packed
+# [capacity, _PK.NC] node table), so the whole tail of the iteration fuses
+# into ONE pallas call:
+#
+#   * cumsum gain scan over both children (shared numeric helper
+#     ``ops.split.split_gain_scan`` — bitwise identical to
+#     find_best_split's XLA scan by construction);
+#   * regularized-gain argmax (first-occurrence, matching jnp.argmax's
+#     row-major tie-break) + winner gather, per child;
+#   * the one-row-gather / three-row-scatter node-table update;
+#   * the NEXT iteration's best-leaf pick over the just-updated table,
+#     emitted as a tiny aux row [leaf', feat', thr', active'] so the XLA
+#     side of the loop shrinks to: partition gathers, seg select, the
+#     histogram kernel, and this call.
+#
+# The E-config batch axis of the fused-cv sweep maps onto the kernel grid
+# via jax.vmap of the pallas_call (leading grid dimension), exactly like
+# the batched histogram kernel.
+#
+# Histogram layout: [2, F, 3, B] with BINS on the 128-lane minor dim — the
+# natural [2, F, B, 3] would pad its 3 stat lanes to 128 (a ~42x VMEM
+# blowup, same failure mode as the r4 transposed-stats note above); the
+# 3-channel axis pads 3 -> 8 sublanes instead (2*F*8*B*4 ~= 2.2 MB at the
+# MSLR F=136 / B=256 shape).
+# ---------------------------------------------------------------------------
+
+
+def _split_iter_kernel(hist_ref, tab_ref, fmask_ref, aux_ref, scal_ref,
+                       out_tab_ref, out_aux_ref, *, K, num_features: int,
+                       num_bins: int, capacity: int):
+    """One whole strict split iteration in VMEM (see block comment above).
+
+    Operands:
+      hist_ref  f32 [2, F, 3, B]   both children's histograms, bins minor;
+      tab_ref   f32 [capacity, NC] packed node table (models.tree._PK);
+      fmask_ref f32 [1, F]         tree-level feature mask (bynode off
+                                   under the eligibility gate);
+      aux_ref   f32 [1, 8]         [leaf, feat, thr, active, 0...] — the
+                                   pick this iteration acts on;
+      scal_ref  f32 [1, 16]        [l1, l2, min_data, min_hess, min_gain,
+                                   max_delta_step, path_smooth, max_depth,
+                                   n_nodes, 0...] (all exact in f32).
+    Outputs: updated table + the next iteration's aux row.
+    """
+    from .split import SplitContext, split_gain_scan, split_stats_valid
+
+    neg_inf = jnp.float32(-jnp.inf)
+    sc = scal_ref[0, :]
+    ctx = SplitContext(
+        lambda_l1=sc[0], lambda_l2=sc[1], min_data_in_leaf=sc[2],
+        min_sum_hessian=sc[3], min_gain_to_split=sc[4],
+        max_delta_step=sc[5], path_smooth=sc[6])
+    max_depth = sc[7]
+    n_nodes = sc[8].astype(jnp.int32)
+
+    aux = aux_ref[0, :]
+    leaf = aux[0].astype(jnp.int32)
+    active = aux[3] > 0.0
+
+    row2 = tab_ref[pl.dslice(leaf, 1), :]             # [1, NC] — ONE gather
+    row = row2[0, :]
+    feat_p, thr_p = row[K.CAND_FEAT], row[K.CAND_BIN]
+    gain_p = row[K.CAND_GAIN]
+    wl_v, wr_v = row[K.CAND_WL], row[K.CAND_WR]
+    lo, hi = row[K.BOUND_LO], row[K.BOUND_HI]
+    child_depth = row[K.DEPTH] + 1.0
+    # mono is None under the gate, so both children inherit (lo, hi) as-is
+    depth_ok = (max_depth <= 0.0) | (child_depth < max_depth)
+    fmask = fmask_ref[0:1, :]                          # [1, F]
+
+    big = jnp.int32(num_features * num_bins)
+
+    def score(c, p_out):
+        """find_best_split's numeric path for one child (shared helper)."""
+        lg = jnp.cumsum(hist_ref[c, :, 0, :], axis=-1)       # [F, B]
+        lh = jnp.cumsum(hist_ref[c, :, 1, :], axis=-1)
+        lc = jnp.cumsum(hist_ref[c, :, 2, :], axis=-1)
+        tg, th, tc = lg[:, -1:], lh[:, -1:], lc[:, -1:]      # [F, 1]
+        rg, rh, rc = tg - lg, th - lh, tc - lc
+        gain, wl, wr = split_gain_scan(lg, lh, lc, rg, rh, rc, tg, th,
+                                       ctx, lo, hi, p_out)
+        valid = (split_stats_valid(lc, rc, lh, rh, gain, ctx)
+                 & (fmask.reshape(num_features, 1) > 0) & depth_ok)
+        gain = jnp.where(valid, gain, neg_inf)
+        best = jnp.max(gain)
+        # first-occurrence flat argmax: min flat index among the maxima
+        # (ties and the all--inf case resolve exactly like jnp.argmax's
+        # row-major scan in the XLA path)
+        flat = (lax.broadcasted_iota(jnp.int32, gain.shape, 0) * num_bins
+                + lax.broadcasted_iota(jnp.int32, gain.shape, 1))
+        idx = jnp.min(jnp.where(gain == best, flat, big))
+        hit = flat == idx
+
+        def pick(x):
+            return jnp.sum(jnp.where(hit, x, 0.0))
+
+        return (best, (idx // num_bins).astype(jnp.float32),
+                (idx % num_bins).astype(jnp.float32),
+                pick(lg), pick(lh), pick(lc), pick(rg), pick(rh), pick(rc),
+                pick(wl), pick(wr))
+
+    bl = score(0, wl_v)
+    br = score(1, wr_v)
+
+    nc = K.NC
+    iota_nc = lax.broadcasted_iota(jnp.int32, (1, nc), 1)
+
+    def make_row(pairs, base=None):
+        out = jnp.zeros((1, nc), jnp.float32) if base is None else base
+        for col, val in pairs:
+            out = jnp.where(iota_nc == col, val, out)
+        return out
+
+    nl_f = n_nodes.astype(jnp.float32)
+    nr_f = nl_f + 1.0
+    leaf_row = make_row([
+        (K.SPLIT_FEAT, feat_p), (K.SPLIT_BIN, thr_p), (K.LEFT, nl_f),
+        (K.RIGHT, nr_f), (K.IS_LEAF, 0.0), (K.SPLIT_GAIN, gain_p)],
+        base=row2)
+    pm = row[K.PM]
+
+    def child_row(b, leaf_val, count):
+        (bg, bf, bb, blg, blh, blc, brg, brh, brc, bwl, bwr) = b
+        return make_row([
+            (K.SPLIT_FEAT, -1.0), (K.LEFT, -1.0), (K.RIGHT, -1.0),
+            (K.LEAF_VALUE, leaf_val), (K.IS_LEAF, 1.0), (K.COUNT, count),
+            (K.DEPTH, child_depth), (K.CAND_GAIN, bg), (K.CAND_FEAT, bf),
+            (K.CAND_BIN, bb), (K.CAND_LG, blg), (K.CAND_LH, blh),
+            (K.CAND_LC, blc), (K.CAND_RG, brg), (K.CAND_RH, brh),
+            (K.CAND_RC, brc), (K.CAND_WL, bwl), (K.CAND_WR, bwr),
+            (K.BOUND_LO, lo), (K.BOUND_HI, hi),
+            (K.PM, jnp.minimum(pm, bg))])
+
+    lrow = child_row(bl, wl_v, row[K.CAND_LC])
+    rrow = child_row(br, wr_v, row[K.CAND_RC])
+
+    out_tab_ref[:] = tab_ref[:]
+
+    @pl.when(active)
+    def _commit():
+        out_tab_ref[pl.dslice(leaf, 1), :] = leaf_row
+        out_tab_ref[pl.dslice(n_nodes, 1), :] = lrow
+        out_tab_ref[pl.dslice(n_nodes + 1, 1), :] = rrow
+
+    # next iteration's best-first pick over the UPDATED table — what the
+    # XLA body recomputed at the top of every trip
+    newtab = out_tab_ref[:]
+    g2 = jnp.where(newtab[:, K.IS_LEAF] > 0.5, newtab[:, K.CAND_GAIN],
+                   neg_inf).reshape(1, capacity)
+    iota_cap = lax.broadcasted_iota(jnp.int32, (1, capacity), 1)
+    best_g = jnp.max(g2)
+    leaf_n = jnp.min(jnp.where(g2 == best_g, iota_cap, capacity))
+    sel_l = iota_cap == leaf_n
+    feat_n = jnp.sum(jnp.where(sel_l, newtab[:, K.CAND_FEAT]
+                               .reshape(1, capacity), 0.0))
+    thr_n = jnp.sum(jnp.where(sel_l, newtab[:, K.CAND_BIN]
+                              .reshape(1, capacity), 0.0))
+    active_n = active & jnp.isfinite(best_g)
+    iota8 = lax.broadcasted_iota(jnp.int32, (1, 8), 1)
+    out_aux_ref[:] = jnp.where(
+        iota8 == 0, leaf_n.astype(jnp.float32),
+        jnp.where(iota8 == 1, feat_n,
+                  jnp.where(iota8 == 2, thr_n,
+                            jnp.where(iota8 == 3,
+                                      active_n.astype(jnp.float32), 0.0))))
+
+
+def split_iter_pallas(hist2_t: jnp.ndarray, table: jnp.ndarray,
+                      fmask: jnp.ndarray, aux: jnp.ndarray,
+                      scal: jnp.ndarray, *, pk,
+                      interpret: bool | None = None):
+    """One strict split iteration in one pallas call (_split_iter_kernel).
+
+    Args:
+      hist2_t: f32 ``[2, F, 3, B]`` both children's histograms (bins
+        minor — transpose of the ``[2, F, B, 3]`` hist_fn output).
+      table: f32 ``[capacity, NC]`` packed node table.
+      fmask: f32 ``[1, F]`` tree-level feature mask.
+      aux: f32 ``[1, 8]`` current pick ``[leaf, feat, thr, active, 0...]``.
+      scal: f32 ``[1, 16]`` traced scalars (see kernel docstring).
+      pk: the static column-layout class (``models.tree._PK``).
+
+    Returns (table', aux').  vmap maps batch axes onto leading grid dims.
+    """
+    capacity, nc = table.shape
+    _, num_features, _, num_bins = hist2_t.shape
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    return pl.pallas_call(
+        functools.partial(_split_iter_kernel, K=pk,
+                          num_features=num_features, num_bins=num_bins,
+                          capacity=capacity),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 5,
+        out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
+        out_shape=[
+            jax.ShapeDtypeStruct((capacity, nc), jnp.float32),
+            jax.ShapeDtypeStruct((1, 8), jnp.float32),
+        ],
+        interpret=interpret,
+    )(hist2_t, table, fmask, aux, scal)
+
+
 def _fused_part_kernel(bins_ref, stats_ref, pv_ref, out_ref, enc_ref, *,
                        num_features: int, num_bins: int, num_segments: int):
     """Wave histogram + ROW PARTITION in one kernel (single f-block).
@@ -488,13 +697,78 @@ def _fused_part_kernel(bins_ref, stats_ref, pv_ref, out_ref, enc_ref, *,
     lax.fori_loop(0, bins_ref.shape[0], body, 0)
 
 
-def partition_fusable(num_features: int, num_bins: int, num_segments: int,
-                      s: int = 3) -> bool:
-    """Static gate for the partition-fused wave kernel: the whole feature
-    axis must fit one VMEM block (phase 1 needs every feature's codes)."""
-    f_blk, n_fblk, _, _ = _vmem_blocking(num_features, num_bins,
-                                         num_segments * s)
-    return n_fblk == 1
+def _fused_part_kernel_mb(bins_ref, stats_ref, pv_ref, wbins_ref, out_ref,
+                          enc_ref, *, num_bins: int, num_segments: int):
+    """Multi-feature-block variant of :func:`_fused_part_kernel`.
+
+    When the feature axis needs more than one VMEM block (MSLR's 136
+    features at 128 lanes), phase 1 cannot select the row's split value
+    from the RESIDENT bins tile — the split feature may live in another
+    block.  Instead the caller gathers the W wave split features' code
+    rows once per wave (``wbins`` [W_pad, n]) and every block routes
+    from that operand, keyed on the row's WAVE RANK rather than its
+    feature id.  Each (f-block, chunk) grid step computes the identical
+    routing in-register — the "cross-block winner select" is thereby a
+    replicated select, not an inter-block reduction — and rewrites the
+    same ``enc`` block with the same value.  Phase 2 is byte-identical
+    to the single-block kernel over this block's features.
+    """
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    chunk = bins_ref.shape[1]
+    s = stats_ref.shape[0]
+    w = num_segments
+
+    sel = pv_ref[0, :]
+    thr = pv_ref[2, :]
+    rank2 = pv_ref[3, :]
+    dl = pv_ref[4, :]
+
+    # phase 1: per-row split value from the wave-gathered code rows —
+    # W VMEM selects keyed on the row's wave rank (2*rank is what the
+    # lookup table carries; see tree.py's tbl_w)
+    def vbody(i, v):
+        code = wbins_ref[pl.dslice(i, 1), :].astype(jnp.float32)
+        return jnp.where(rank2 == (2 * i).astype(jnp.float32),
+                         code[0, :], v)
+
+    v = lax.fori_loop(0, w, vbody, jnp.zeros((chunk,), jnp.float32))
+    psel = sel > 0.0
+    go_left = v <= thr
+    to_direct = psel & (go_left == (dl > 0.0))
+    seg = jnp.where(to_direct, (rank2 * 0.5).astype(jnp.int32),
+                    jnp.int32(w)).reshape(1, chunk)
+    enc_ref[:] = jnp.where(
+        psel, rank2.astype(jnp.int32) + jnp.where(go_left, 0, 1) + 1,
+        0).reshape(1, chunk)
+
+    # phase 2: standard segment-folded accumulation over THIS block's
+    # features (see _fused_part_kernel)
+    stats = stats_ref[:]
+    iota_r = lax.broadcasted_iota(jnp.int32, (w * s, chunk), 0)
+    seg_match = seg == iota_r // s
+    proj_t = (lax.broadcasted_iota(jnp.int32, (w * s, s), 0) % s
+              == lax.broadcasted_iota(jnp.int32, (w * s, s), 1))
+    spread = lax.dot_general(
+        proj_t.astype(jnp.float32), stats.astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    operand = jnp.where(seg_match, spread, 0.0).astype(jnp.bfloat16)
+    iota_bt = lax.broadcasted_iota(jnp.int32, (num_bins, chunk), 0)
+
+    def body(f, _):
+        codes_t = bins_ref[pl.dslice(f, 1), :]
+        onehot_t = (iota_bt == codes_t).astype(jnp.bfloat16)
+        tile = lax.dot_general(
+            onehot_t, operand,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out_ref[pl.dslice(f, 1), :, :] += tile[None]
+        return _
+
+    lax.fori_loop(0, bins_ref.shape[0], body, 0)
 
 
 def prepare_wave_operands(bins: jnp.ndarray, stats: jnp.ndarray,
@@ -502,25 +776,27 @@ def prepare_wave_operands(bins: jnp.ndarray, stats: jnp.ndarray,
     """One-time (per tree) prep for :func:`hist_partition_fused_pallas`:
     transpose + row-pad the loop-invariant operands OUTSIDE the growth
     while_loop (the in-call pad/convert re-ran per wave — ~2.7 ms each at
-    11M rows, r5 trace)."""
+    11M rows, r5 trace).  When the feature axis needs multiple VMEM
+    blocks (F > ~45; MSLR), the feature axis is zero-padded to a whole
+    number of blocks here — the r7 multi-block kernel trims the padded
+    histogram rows on the way out."""
     n, num_features = bins.shape
     s = stats.shape[1]
     k = num_segments * s
     f_blk, n_fblk, f_pad, chunk = _vmem_blocking(num_features, num_bins, k,
                                                  chunk_align=512)
-    assert n_fblk == 1, "partition fusion requires a single feature block"
     n_chunks = -(-n // chunk)
     pad = n_chunks * chunk - n
     bins_t = bins.astype(jnp.int32).T
     stats_t = stats.T
-    if pad:
-        bins_t = jnp.pad(bins_t, ((0, 0), (0, pad)))
+    if pad or f_pad:
+        bins_t = jnp.pad(bins_t, ((0, f_pad), (0, pad)))
         stats_t = jnp.pad(stats_t, ((0, 0), (0, pad)))
     return bins_t, stats_t, chunk
 
 
 def hist_partition_fused_pallas(
-    bins_t: jnp.ndarray,         # [F, n_pad] i32 (prepare_wave_operands)
+    bins_t: jnp.ndarray,         # [F_pad, n_pad] i32 (prepare_wave_operands)
     stats_t: jnp.ndarray,        # [S, n_pad] f32 (prepare_wave_operands)
     pv_t: jnp.ndarray,           # [8, n_pad] f32 per-row node fields
     num_segments: int,
@@ -528,46 +804,102 @@ def hist_partition_fused_pallas(
     chunk: int,
     interpret: bool | None = None,
     hist_dtype: str = "bf16",
+    wfeat: jnp.ndarray | None = None,   # [W] i32 wave split features
+    num_features: int | None = None,    # nominal F (bins_t may be f-padded)
 ):
     """Fused wave pass: histogram over the direct children PLUS the row
     partition (see _fused_part_kernel).  Returns
     (hist f32 [num_segments, F, num_bins, S], enc i32 [n_pad]).
+
+    Single VMEM feature block: the r5 kernel routes from the resident
+    bins tile.  Multiple blocks (F > ~45, r7): the W wave split
+    features' code rows are gathered once (``wfeat`` required) and the
+    multi-block kernel routes every block from that [W_pad, n] operand
+    — see :func:`_fused_part_kernel_mb`.
     """
-    num_features, n_pad = bins_t.shape
+    f_rows, n_pad = bins_t.shape
+    if num_features is None:
+        num_features = f_rows
     s = stats_t.shape[0]
     k = num_segments * s
     n_chunks = n_pad // chunk
+    f_blk, n_fblk, _, _ = _vmem_blocking(num_features, num_bins, k,
+                                         chunk_align=512)
+    assert f_rows == n_fblk * f_blk, (f_rows, n_fblk, f_blk)
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
 
-    def one_pass(stats_arr):
-        return pl.pallas_call(
-            functools.partial(_fused_part_kernel,
-                              num_features=num_features,
-                              num_bins=num_bins,
-                              num_segments=num_segments),
-            grid=(n_chunks,),
-            in_specs=[
-                pl.BlockSpec((num_features, chunk), lambda c: (0, c),
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((s, chunk), lambda c: (0, c),
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((8, chunk), lambda c: (0, c),
-                             memory_space=pltpu.VMEM),
-            ],
-            out_specs=[
-                pl.BlockSpec((num_features, num_bins, k), lambda c: (0, 0, 0),
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, chunk), lambda c: (0, c),
-                             memory_space=pltpu.VMEM),
-            ],
-            out_shape=[
-                jax.ShapeDtypeStruct((num_features, num_bins, k),
-                                     jnp.float32),
-                jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
-            ],
-            interpret=interpret,
-        )(bins_t, stats_arr, pv_t)
+    if n_fblk == 1:
+        def one_pass(stats_arr):
+            return pl.pallas_call(
+                functools.partial(_fused_part_kernel,
+                                  num_features=num_features,
+                                  num_bins=num_bins,
+                                  num_segments=num_segments),
+                grid=(n_chunks,),
+                in_specs=[
+                    pl.BlockSpec((num_features, chunk), lambda c: (0, c),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((s, chunk), lambda c: (0, c),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((8, chunk), lambda c: (0, c),
+                                 memory_space=pltpu.VMEM),
+                ],
+                out_specs=[
+                    pl.BlockSpec((num_features, num_bins, k),
+                                 lambda c: (0, 0, 0),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((1, chunk), lambda c: (0, c),
+                                 memory_space=pltpu.VMEM),
+                ],
+                out_shape=[
+                    jax.ShapeDtypeStruct((num_features, num_bins, k),
+                                         jnp.float32),
+                    jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
+                ],
+                interpret=interpret,
+            )(bins_t, stats_arr, pv_t)
+    else:
+        if wfeat is None:
+            raise ValueError(
+                "multi-block partition fusion needs the wave split "
+                "features (wfeat) to gather the routing code rows")
+        w_pad = -(-num_segments // 8) * 8
+        wf = jnp.clip(wfeat.astype(jnp.int32), 0, num_features - 1)
+        if w_pad != num_segments:
+            wf = jnp.pad(wf, (0, w_pad - num_segments))
+        wbins_t = jnp.take(bins_t, wf, axis=0)           # [W_pad, n_pad]
+
+        def one_pass(stats_arr):
+            return pl.pallas_call(
+                functools.partial(_fused_part_kernel_mb,
+                                  num_bins=num_bins,
+                                  num_segments=num_segments),
+                grid=(n_fblk, n_chunks),
+                in_specs=[
+                    pl.BlockSpec((f_blk, chunk), lambda f, c: (f, c),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((s, chunk), lambda f, c: (0, c),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((8, chunk), lambda f, c: (0, c),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((w_pad, chunk), lambda f, c: (0, c),
+                                 memory_space=pltpu.VMEM),
+                ],
+                out_specs=[
+                    pl.BlockSpec((f_blk, num_bins, k),
+                                 lambda f, c: (f, 0, 0),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((1, chunk), lambda f, c: (0, c),
+                                 memory_space=pltpu.VMEM),
+                ],
+                out_shape=[
+                    jax.ShapeDtypeStruct((f_rows, num_bins, k),
+                                         jnp.float32),
+                    jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
+                ],
+                interpret=interpret,
+            )(bins_t, stats_arr, pv_t, wbins_t)
 
     if hist_dtype in ("f32", "f32x"):
         hi = stats_t.astype(jnp.bfloat16).astype(jnp.float32)
@@ -576,7 +908,7 @@ def hist_partition_fused_pallas(
         out = h1 + h2
     else:
         out, enc = one_pass(stats_t)
-    out = out.reshape(num_features, num_bins, num_segments, s)
+    out = out[:num_features].reshape(num_features, num_bins, num_segments, s)
     return out.transpose(2, 0, 1, 3), enc[0]
 
 

@@ -97,6 +97,41 @@ def constrained_leaf_output(sum_g, sum_h, count, ctx: SplitContext,
     return jnp.clip(w, jnp.maximum(lo, -cap), jnp.minimum(hi, cap))
 
 
+def split_gain_scan(lg, lh, lc, rg, rh, rc, tg, th, ctx: SplitContext,
+                    lo, hi, p_out):
+    """Core regularized-gain evaluation over channel-split cumsum arrays.
+
+    SINGLE SOURCE for the numeric gain formula: :func:`find_best_split`
+    (the XLA scan) and the Pallas split-iteration mega-kernel
+    (``ops.histogram_pallas._split_iter_kernel``) both call this pure-jnp
+    helper, so the two paths agree BITWISE by construction — same ops in
+    the same order on the same operands (the kernel's interpret mode IS
+    jax ops, and the parity suite asserts exact equality).
+
+    Returns (gain, wl, wr) with shapes following the broadcast of the
+    inputs (``[F, B]`` in the scan, lane-tiled in the kernel).
+    """
+    wl = constrained_leaf_output(lg, lh, lc, ctx, lo, hi, p_out)
+    wr = constrained_leaf_output(rg, rh, rc, ctx, lo, hi, p_out)
+    parent_obj = leaf_objective_at(p_out, tg, th, ctx)
+    gain = (leaf_objective_at(wl, lg, lh, ctx)
+            + leaf_objective_at(wr, rg, rh, ctx) - parent_obj)
+    return gain, wl, wr
+
+
+def split_stats_valid(lc, rc, lh, rh, gain, ctx: SplitContext):
+    """Shared data-driven validity mask (min_data / min_hessian /
+    min_gain) — the feature-mask and depth terms stay caller-side, since
+    their shapes differ between the XLA scan and the mega-kernel."""
+    return (
+        (lc >= ctx.min_data_in_leaf)
+        & (rc >= ctx.min_data_in_leaf)
+        & (lh >= ctx.min_sum_hessian)
+        & (rh >= ctx.min_sum_hessian)
+        & (gain > ctx.min_gain_to_split)
+    )
+
+
 class CatInfo(NamedTuple):
     """Static-per-dataset categorical split configuration.
 
@@ -184,18 +219,11 @@ def find_best_split(
     hi = jnp.float32(jnp.inf) if bound_hi is None else bound_hi
     p_out = (leaf_output(tg, th, ctx) if parent_out is None
              else parent_out)                      # [F,1] or scalar
-    wl = constrained_leaf_output(lg, lh, lc, ctx, lo, hi, p_out)  # [F, B]
-    wr = constrained_leaf_output(rg, rh, rc, ctx, lo, hi, p_out)
-    parent_obj = leaf_objective_at(p_out, tg, th, ctx)  # [F, 1] or scalar
-    gain = (leaf_objective_at(wl, lg, lh, ctx)
-            + leaf_objective_at(wr, rg, rh, ctx) - parent_obj)  # [F, B]
+    gain, wl, wr = split_gain_scan(lg, lh, lc, rg, rh, rc, tg, th, ctx,
+                                   lo, hi, p_out)  # [F, B]
 
     valid = (
-        (lc >= ctx.min_data_in_leaf)
-        & (rc >= ctx.min_data_in_leaf)
-        & (lh >= ctx.min_sum_hessian)
-        & (rh >= ctx.min_sum_hessian)
-        & (gain > ctx.min_gain_to_split)
+        split_stats_valid(lc, rc, lh, rh, gain, ctx)
         & (feature_mask[:, None] > 0)
         & depth_ok
     )
@@ -242,25 +270,17 @@ def find_best_split(
     ctx_cat = ctx._replace(lambda_l2=ctx.lambda_l2 + cat_info.cat_l2)
     p_out_cat = (leaf_output(tg, th, ctx_cat) if parent_out is None
                  else parent_out)
-    parent_cat = leaf_objective_at(p_out_cat, tg, th, ctx_cat)
 
     def scan_direction(order):
         hist_s = jnp.take_along_axis(hist, order[..., None], axis=1)
         cum_s = jnp.cumsum(hist_s, axis=1)
         slg, slh, slc = cum_s[..., 0], cum_s[..., 1], cum_s[..., 2]
         srg, srh, src = tg - slg, th - slh, tc - slc
-        swl = constrained_leaf_output(slg, slh, slc, ctx_cat, lo, hi,
-                                      p_out_cat)
-        swr = constrained_leaf_output(srg, srh, src, ctx_cat, lo, hi,
-                                      p_out_cat)
-        gain_c = (leaf_objective_at(swl, slg, slh, ctx_cat)
-                  + leaf_objective_at(swr, srg, srh, ctx_cat) - parent_cat)
+        gain_c, swl, swr = split_gain_scan(slg, slh, slc, srg, srh, src,
+                                           tg, th, ctx_cat, lo, hi,
+                                           p_out_cat)
         valid_c = (
-            (slc >= ctx.min_data_in_leaf)
-            & (src >= ctx.min_data_in_leaf)
-            & (slh >= ctx.min_sum_hessian)
-            & (srh >= ctx.min_sum_hessian)
-            & (gain_c > ctx.min_gain_to_split)
+            split_stats_valid(slc, src, slh, srh, gain_c, ctx)
             & (feature_mask[:, None] > 0)
             & depth_ok
             & (pos < cat_info.max_cat_threshold)

@@ -77,8 +77,12 @@ class PredictorRuntime:
         self._obj = packed._objective()
         self._cache: "OrderedDict[tuple, object]" = OrderedDict()
         self.num_compiles = 0                      # lifetime program builds
+        self.warmed_buckets = 0                    # precompiled via warm()
         self.buckets = [1 << i
                         for i in range(self.max_bucket.bit_length())]
+        # compile-cache counters ride along in every stats snapshot (the
+        # serve CLI prints ONE dict on shutdown; tools embed the same)
+        self.stats.attach_cache(self.cache_info)
 
     # -- public API ----------------------------------------------------------
     def predict(self, data, num_iteration: Optional[int] = None,
@@ -108,12 +112,45 @@ class PredictorRuntime:
         return outs[0] if len(outs) == 1 else np.concatenate(outs)
 
     def cache_info(self) -> dict:
+        # counters only — this runs inside every stats snapshot, so no
+        # per-call rebuild of a stringified key list
         return {
             "entries": len(self._cache),
             "max_entries": self.max_cache_entries,
             "num_compiles": self.num_compiles,
-            "keys": [list(map(str, k)) for k in self._cache.keys()],
+            "warmed_buckets": self.warmed_buckets,
+            "buckets_live": sorted({k[0] for k in self._cache}),
         }
+
+    def warm(self, raw_score: bool = False, buckets=None) -> int:
+        """Precompile the bucket ladder before traffic arrives.
+
+        Dispatches one fully-masked all-zeros batch per bucket so each
+        size class's compile cost lands at startup instead of on its
+        first real request.  Warm batches use the same uint8 codes dtype
+        the edge transform produces, so the compiled programs are
+        exactly the ones traffic will hit.  When the ladder exceeds the
+        LRU bound only the LARGEST ``max_cache_entries`` buckets are
+        warmed — warming more would evict programs just built.  Returns
+        the number of programs compiled.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        todo = list(buckets) if buckets is not None else list(self.buckets)
+        if len(todo) > self.max_cache_entries:
+            todo = todo[-self.max_cache_entries:]
+        bundler = getattr(self.packed.bin_mapper, "bundler", None)
+        n_cols = (bundler.num_columns if bundler is not None
+                  else self.packed.num_feature())
+        before = self.num_compiles
+        for b in todo:
+            fn = self._get_fn(b, raw_score)
+            jax.block_until_ready(fn(
+                jnp.zeros((b, n_cols), jnp.uint8),
+                jnp.zeros(b, jnp.float32), jnp.int32(1)))
+        self.warmed_buckets += len(todo)
+        return self.num_compiles - before
 
     # -- internals -----------------------------------------------------------
     def _dispatch(self, codes: np.ndarray, k: int,

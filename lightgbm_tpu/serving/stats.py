@@ -69,6 +69,14 @@ class ServingStats:
         self.timeouts = 0            # requests expired before dispatch
         self.fallbacks = 0           # graceful-degradation CPU predicts
         self.queue_latencies = deque(maxlen=RESERVOIR)
+        self._cache_info = None      # zero-arg callable set by the runtime
+
+    def attach_cache(self, provider) -> None:
+        """Register a zero-arg callable returning compile-cache counters;
+        its dict lands under ``compile_cache`` in every snapshot (keeps
+        this module free of the runtime while the serve CLI still prints
+        ONE shutdown dict)."""
+        self._cache_info = provider
 
     def _b(self, bucket: int) -> _BucketStats:
         bs = self._buckets.get(bucket)
@@ -108,7 +116,7 @@ class ServingStats:
 
     # -- reporting ---------------------------------------------------------
     def snapshot(self) -> dict:
-        return {
+        out = {
             "requests": self.requests,
             "batched_dispatches": self.batched_dispatches,
             "timeouts": self.timeouts,
@@ -120,3 +128,6 @@ class ServingStats:
             "buckets": [self._buckets[b].snapshot(b)
                         for b in sorted(self._buckets)],
         }
+        if self._cache_info is not None:
+            out["compile_cache"] = self._cache_info()
+        return out

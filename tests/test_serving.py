@@ -342,6 +342,39 @@ def test_stats_snapshot_counters(reg_booster, tmp_path):
     json.dumps(snap)                         # snapshot is JSON-able
 
 
+def test_warm_buckets_precompiles_ladder(reg_booster, tmp_path):
+    """warm() builds the whole ladder up front; subsequent traffic of any
+    size class is pure cache hits (r7 satellite)."""
+    X, b = reg_booster
+    rt = PredictorRuntime(_roundtrip(b, tmp_path), max_bucket=64)
+    n = rt.warm()
+    assert n == len(rt.buckets) == rt.warmed_buckets        # 1..64 fits
+    c = rt.num_compiles
+    for sz in (1, 2, 5, 33, 64):
+        got = rt.predict(np.resize(X, (sz, X.shape[1])))
+        assert got.shape == (sz,)
+    assert rt.num_compiles == c              # zero compiles on traffic
+    # ladder larger than the LRU: warm only the LARGEST entries that fit
+    # (warming all would evict programs it just built)
+    rt2 = PredictorRuntime(_roundtrip(b, tmp_path, name="m2.npz"),
+                           max_bucket=1024, max_cache_entries=3)
+    assert rt2.warm() == 3
+    assert sorted(k[0] for k in rt2._cache) == [256, 512, 1024]
+
+
+def test_snapshot_folds_compile_cache(reg_booster, tmp_path):
+    X, b = reg_booster
+    rt = PredictorRuntime(_roundtrip(b, tmp_path), max_bucket=256,
+                          stats=ServingStats())
+    rt.predict(X[:5])
+    snap = rt.stats.snapshot()
+    cc = snap["compile_cache"]
+    assert cc["num_compiles"] == rt.num_compiles == 1
+    assert cc["buckets_live"] == [8]
+    assert cc["warmed_buckets"] == 0
+    json.dumps(snap)
+
+
 # ---------------------------------------------------------------------------
 # micro-batching queue (mocked clock, no sleeps)
 # ---------------------------------------------------------------------------
@@ -472,6 +505,28 @@ def test_cli_serve_inprocess(cat_booster, tmp_path):
     assert np.abs(preds - b.predict(X[:7])).max() <= TOL
     snap = json.loads(err.getvalue())
     assert snap["requests"] == 7
+
+
+def test_cli_serve_warm_buckets(cat_booster, tmp_path):
+    from lightgbm_tpu.__main__ import _serve
+
+    X, b = cat_booster
+    path = os.path.join(str(tmp_path), "serve_warm.npz")
+    pack_booster(b).save(path)
+    lines = "\n".join(",".join(f"{v:.6f}" for v in X[i]) for i in range(3))
+    out, err = io.StringIO(), io.StringIO()
+    rc = _serve(path, {"warm_buckets": "true", "max_bucket": "8",
+                       "show_stats": "true"},
+                stdin=io.StringIO(lines + "\n"), stdout=out, stderr=err)
+    assert rc == 0
+    preds = np.array([float(x) for x in out.getvalue().split()])
+    assert np.abs(preds - b.predict(X[:3])).max() <= TOL
+    err_lines = err.getvalue().strip().splitlines()
+    assert "warmed 4" in err_lines[0]        # ladder 1,2,4,8
+    snap = json.loads(err_lines[-1])
+    assert snap["compile_cache"]["warmed_buckets"] == 4
+    # the request traffic itself compiled nothing new
+    assert snap["compile_cache"]["num_compiles"] == 4
 
 
 def test_cli_serve_json_and_error_lines(mc_booster, tmp_path):
