@@ -766,6 +766,13 @@ def main() -> None:
                              "bench_higgs_parity_auc(500_000, 100)",
                              "bench_higgs_parity_auc(200_000, 100)"],
             reserved_cap(420, 150), retries=0)
+    # launch model vs the declarative graftlint budgets (r8): the BENCH
+    # artifact and the lint gate read the SAME spec table
+    # (lightgbm_tpu.analysis.budgets.LAUNCH_BUDGETS), so they cannot
+    # disagree about kernels_per_round.  E=8 compiles ~5x faster than
+    # the production E=40 bucket with identical per-iteration counts.
+    section("launch_model", "launch_model_section()",
+            reserved_cap(300, 120), retries=0)
     # the sweep runs LAST and capped: it can only eat its own budget
     # (r4's artifact lost every north-star section to exactly this)
     sweep_cap = int(min(1200, max(remaining() - 60, 0)))
@@ -776,6 +783,19 @@ def main() -> None:
     else:
         out["sweep_skipped"] = f"budget exhausted ({remaining():.0f}s left)"
     emit()
+
+
+def launch_model_section():
+    """kernels_per_round + budget deltas from the graftlint spec table."""
+    from lightgbm_tpu.analysis.budgets import (budget_by_name,
+                                               kernels_per_round_summary)
+
+    s = kernels_per_round_summary(e=8)
+    out = {f"launch_{k}": v for k, v in s.items()}
+    spec = budget_by_name("cv_tpu_model")
+    out["launch_budget_headroom_per_iter"] = (
+        spec.budget - s["split_iter_kernels_tpu_model"])
+    return out
 
 
 def diamonds_section():

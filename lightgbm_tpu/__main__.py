@@ -101,7 +101,13 @@ def _split_label(data: np.ndarray, names: List[str],
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    cfg = parse_argv(list(sys.argv[1:] if argv is None else argv))
+    raw = list(sys.argv[1:] if argv is None else argv)
+    if raw and raw[0] == "lint":
+        # graftlint front end — flag-style argv, not key=value config
+        from .analysis.cli import main as lint_main
+
+        return lint_main(raw[1:])
+    cfg = parse_argv(raw)
     task = cfg.pop("task", "train")
     header = cfg.pop("header", "false").lower() in ("true", "1", "yes")
     label_spec = cfg.pop("label_column", "0")

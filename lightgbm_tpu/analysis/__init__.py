@@ -1,0 +1,20 @@
+"""graftlint — static + trace-level enforcement of the workbench's
+compile-time invariants.
+
+Layer 1 (:mod:`.rules`, :mod:`.engine`): pure-AST detection of JAX
+footguns (traced-value branching, host syncs in traced code, f64 traps,
+static_argnames misuse, in-place mutation, donated-buffer reuse, kernel
+dots without an accumulation dtype), with accepted debt ledgered in
+``baseline.toml`` (:mod:`.baseline`).
+
+Layer 2 (:mod:`.budgets`, :mod:`.vmem`): declarative per-entry-point HLO
+launch budgets, zero-recompile guarantees for the serving bucket ladder
+and the fused train step, and padded VMEM footprints vs the 16 MB v5e
+scope.
+
+Front ends: ``python -m lightgbm_tpu lint`` (:mod:`.cli`),
+``tests/test_graftlint.py`` (tier-1 bridge), ``tools/check.sh``.
+"""
+
+from .engine import LintReport, run_lint          # noqa: F401
+from .rules import RULE_IDS, Finding, analyze_source  # noqa: F401

@@ -1,0 +1,418 @@
+"""graftlint Layer 2 — trace-level invariant checks.
+
+Three invariant families, all declarative so the bench, the tests and the
+lint gate consume ONE model instead of three hand-synced copies:
+
+* :data:`LAUNCH_BUDGETS` — per-entry-point kernel-launch budgets.  Each
+  spec lowers a public entry point (strict grower split iteration,
+  fused-CV round, packed-forest predict) to compiled HLO on this host and
+  counts fusion/custom-call instructions in the dominant loop body — the
+  r4/r5 lesson that the training floor is launch count, not FLOPs.
+* :data:`RECOMPILE_SPECS` — zero-recompile guarantees.  The serving
+  runtime must hold at most ``log2(max_bucket)+1`` programs across a
+  batch-size sweep, and the fused train step must hold ONE program across
+  different hyper-parameter batches and segment bounds (hyperparameters
+  are traced values, not static).
+* VMEM footprints live in :mod:`lightgbm_tpu.analysis.vmem` (pure math,
+  no compilation — they run in the default ``lint`` pass).
+
+The split-iteration HLO machinery moved here from ``tools/hlo_counts.py``
+(r7), which is now a thin re-export shim so there is exactly one
+launch-count model.
+
+Everything JAX-touching imports lazily: Layer 1 linting must not pay for
+an accelerator stack import.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# compiled-HLO op counting (canonical home; tools/hlo_counts.py re-exports)
+# ---------------------------------------------------------------------------
+
+
+def compiled_text(fn, *args):
+    import jax
+
+    return jax.jit(fn).lower(*args).compile().as_text()
+
+
+def fusion_count(txt: str) -> int:
+    return len(re.findall(r" fusion\(", txt))
+
+
+def custom_call_count(txt: str) -> int:
+    # instruction form only ("= ... custom-call(...)") — bare
+    # "custom-call" also appears in get-tuple-element operand types
+    return len(re.findall(r" custom-call\(", txt))
+
+
+def while_body_counts(txt: str):
+    """Per while-body (fusions, custom_calls, chars) from compiled HLO."""
+    out = {}
+    for b in set(re.findall(r"body=%?([\w.\-]+)", txt)):
+        m = re.search(r"(?m)^(%?" + re.escape(b)
+                      + r" \([^\n]*\n(?:.*\n)*?)(?=^\}|^%|^ENTRY)", txt)
+        if m:
+            blk = m.group(1)
+            out[b] = (len(re.findall(r" fusion\(", blk)),
+                      len(re.findall(r" custom-call\(", blk)), len(blk))
+    return out
+
+
+def main_body_counts(txt: str):
+    """(fusions, custom_calls) of the LARGEST while body — the growth
+    loop dominates every grower program."""
+    bodies = while_body_counts(txt)
+    if not bodies:
+        return fusion_count(txt), custom_call_count(txt)
+    f, c, _ = max(bodies.values(), key=lambda v: v[2])
+    return f, c
+
+
+# ---------------------------------------------------------------------------
+# tiny synthetic fixtures (never touch real data; shapes stay cheap on CPU)
+# ---------------------------------------------------------------------------
+
+
+def _grow_fixture(num_features=7, num_bins=16, n=4096, e=None, seed=0):
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    bins = jnp.asarray(rng.randint(0, num_bins, size=(n, num_features)),
+                       jnp.int32)
+    shape = (n,) if e is None else (e, n)
+    g = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    ones = jnp.ones(shape, jnp.float32)
+    stats = jnp.stack([g, ones, ones], -1)
+    fmask = jnp.ones(num_features, jnp.float32)
+    return bins, stats, fmask
+
+
+def split_iter_counts(fuse_split: bool, e=None, num_leaves=31,
+                      num_bins=16, n=4096, stub=False):
+    """(fusions, custom_calls) per split iteration of the strict grower
+    (``e=None``) or the E-batched fused-CV tree growth (``e=E``).
+
+    ``stub=True`` swaps the Pallas mega-kernel for a pure_callback so the
+    body compiles to XLA-side fusions + ONE custom-call — the launch
+    structure a TPU build has (interpret-mode Pallas INLINES the kernel
+    on CPU, inflating the fused count)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import tree as tree_mod
+    from ..models.tree import grow_tree
+    from ..ops.split import SplitContext
+
+    bins, stats, fmask = _grow_fixture(num_bins=num_bins, n=n, e=e)
+    ctx = SplitContext(jnp.float32(0.0), jnp.float32(1.0), jnp.float32(3.0),
+                       jnp.float32(1e-3), jnp.float32(0.0))
+
+    def grow(s):
+        return grow_tree(bins, s, fmask, ctx, num_leaves, num_bins, 0,
+                         fuse_split=fuse_split)
+
+    fn = (lambda: grow(stats)) if e is None else (
+        lambda: jax.vmap(grow)(stats))
+    old = tree_mod._SPLIT_ITER_OPCOUNT_STUB
+    tree_mod._SPLIT_ITER_OPCOUNT_STUB = stub and fuse_split
+    try:
+        txt = compiled_text(fn)
+    finally:
+        tree_mod._SPLIT_ITER_OPCOUNT_STUB = old
+    return main_body_counts(txt)
+
+
+def tiny_packed_forest(num_trees: int = 3, num_features: int = 2):
+    """A hand-built, validated PackedForest: one root split per tree.
+
+    Deterministic and instant — the serving budget/recompile specs must
+    not pay a training run to measure a predict program."""
+    import numpy as np
+
+    from ..dataset import BinMapper
+    from ..serving.packed import PackedForest
+
+    t, m = num_trees, 3
+    split_feature = np.zeros((t, m), np.int32)
+    split_bin = np.zeros((t, m), np.int32)          # go left on bin 0
+    left = np.full((t, m), -1, np.int32)
+    right = np.full((t, m), -1, np.int32)
+    left[:, 0], right[:, 0] = 1, 2
+    is_leaf = np.zeros((t, m), bool)
+    is_leaf[:, 1:] = True
+    leaf_value = np.zeros((t, m), np.float32)
+    leaf_value[:, 1], leaf_value[:, 2] = -0.5, 0.5
+    mapper = BinMapper(
+        upper_bounds=[np.asarray([0.5]) for _ in range(num_features)],
+        nan_bin=np.full(num_features, -1, np.int32),
+        n_bins=np.full(num_features, 2, np.int32))
+    return PackedForest(
+        split_feature=split_feature, split_bin=split_bin,
+        left=left, right=right, leaf_value=leaf_value, is_leaf=is_leaf,
+        is_cat_split=None, cat_mask=None, shrink=1.0,
+        init_score=np.zeros(1, np.float32), num_class=1,
+        best_iteration=num_trees, depth_cap=1,
+        params={"objective": "regression"},
+        bin_mapper_dict=mapper.to_dict()).validate()
+
+
+def serving_predict_counts(bucket: int = 8):
+    """(fusions, custom_calls) of one packed-forest predict program at a
+    fixed bucket shape — the whole program (the traversal while-loop is
+    capacity-bounded and unrolls into the counted bodies)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..serving.runtime import PredictorRuntime
+
+    rt = PredictorRuntime(tiny_packed_forest(), max_bucket=max(bucket, 1),
+                          donate=False)
+    codes = jnp.zeros((bucket, rt.packed.num_feature()), jnp.int32)
+    mask = jnp.ones((bucket,), jnp.float32)
+    fn = rt._build_fn(raw_score=False)
+    txt = fn.lower(codes, mask, jnp.int32(rt.packed.num_trees)).compile(
+    ).as_text()
+    del np
+    return fusion_count(txt), custom_call_count(txt)
+
+
+def kernels_per_round_summary(e=40, num_leaves=31):
+    """The bench-artifact dict: per-split-iteration launch counts for the
+    fused-CV bucket shape, CPU-measured plus the TPU launch model —
+    cross-referenced against the declarative budgets so BENCH artifacts
+    and the lint gate cannot disagree."""
+    unf_f, unf_c = split_iter_counts(False, e=e, num_leaves=num_leaves)
+    cpu_f, cpu_c = split_iter_counts(True, e=e, num_leaves=num_leaves)
+    xla_f, xla_c = split_iter_counts(True, e=e, num_leaves=num_leaves,
+                                     stub=True)
+    iters = num_leaves - 1
+    model = xla_f + xla_c
+    # r4's TPU-measured per-split-iteration launch count at this bucket
+    # shape (PERF.md "Result: 49 fusions + 1 custom-call per split
+    # iteration"; the "~1,500 kernels/round" exec floor)
+    r4_per_iter = 50
+    budget = budget_by_name("cv_tpu_model").budget
+    return {
+        "split_iter_kernels_r4_baseline": r4_per_iter,
+        "split_iter_kernels_unfused_cpu": unf_f + unf_c,
+        "split_iter_kernels_fused_cpu_inlined": cpu_f + cpu_c,
+        "split_iter_kernels_tpu_model": model,
+        "split_iter_budget_tpu_model": budget,
+        "split_iter_within_budget": bool(model <= budget),
+        "kernels_per_round_r4_baseline": r4_per_iter * iters,
+        "kernels_per_round_unfused_cpu": (unf_f + unf_c) * iters,
+        "kernels_per_round": model * iters,
+        "kernels_per_round_budget": budget * iters,
+        "kernels_per_round_drop_x": round(r4_per_iter / model, 2),
+        "kernels_per_round_drop_x_vs_cpu_unfused":
+            round((unf_f + unf_c) / model, 2),
+    }
+
+
+# ---------------------------------------------------------------------------
+# declarative launch budgets
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class LaunchBudget:
+    """One entry point, one measured launch count, one ceiling.
+
+    ``kind`` selects the measurement: ``split_iter`` lowers the grower
+    (strict when ``e is None``, E-batched fused-CV otherwise, Pallas
+    swapped for a pure_callback when ``stub`` — the TPU launch model);
+    ``serving_predict`` lowers the packed-forest bucket program.
+    Budgets are measured values + ~25% headroom, never aspirations.
+    """
+
+    name: str
+    budget: int
+    kind: str = "split_iter"            # "split_iter" | "serving_predict"
+    fuse_split: bool = True
+    e: Optional[int] = None
+    stub: bool = False
+    bucket: int = 8
+    note: str = ""
+
+    def measure(self) -> int:
+        if self.kind == "split_iter":
+            f, c = split_iter_counts(self.fuse_split, e=self.e,
+                                     stub=self.stub)
+        elif self.kind == "serving_predict":
+            f, c = serving_predict_counts(self.bucket)
+        else:
+            raise ValueError(f"unknown budget kind {self.kind!r}")
+        return f + c
+
+    def check(self) -> Dict[str, object]:
+        measured = self.measure()
+        return {"name": self.name, "kind": self.kind,
+                "measured": measured, "budget": self.budget,
+                "ok": measured <= self.budget, "note": self.note}
+
+
+# Measured on the r7 jax pin: strict (23 unfused / 45 fused-inlined /
+# 5+1 stub), E-batched (21 / 53 / 5+1); E=8 compiles ~5x faster than the
+# production E=40 bucket with IDENTICAL per-iteration body counts
+# (vmapped ops don't multiply with batch size) — verified against E=40
+# when the budget was set.
+LAUNCH_BUDGETS: Tuple[LaunchBudget, ...] = (
+    LaunchBudget("strict_unfused", 29, fuse_split=False,
+                 note="strict grower, r6 unfused split iteration"),
+    LaunchBudget("strict_fused_cpu", 56,
+                 note="interpret-mode Pallas inlined; CPU regression pin"),
+    LaunchBudget("strict_tpu_model", 8, stub=True,
+                 note="XLA fusions + 1 mega-kernel custom-call = TPU "
+                      "launches per split iteration"),
+    LaunchBudget("cv_unfused", 27, fuse_split=False, e=8,
+                 note="fused-CV hyper-batch, unfused split iteration"),
+    LaunchBudget("cv_fused_cpu", 66, e=8,
+                 note="interpret-mode Pallas inlined; CPU regression pin"),
+    LaunchBudget("cv_tpu_model", 8, e=8, stub=True,
+                 note="the r7 tentpole: >=3x drop vs the 50/iter r4 "
+                      "TPU-measured baseline"),
+    LaunchBudget("serving_predict_b8", 6, kind="serving_predict",
+                 bucket=8,
+                 note="packed-forest bucket program, whole-program count "
+                      "(measured 3 on the r8 jax pin)"),
+)
+
+
+def budget_by_name(name: str) -> LaunchBudget:
+    for b in LAUNCH_BUDGETS:
+        if b.name == name:
+            return b
+    raise KeyError(name)
+
+
+def check_launch_budgets(names: Optional[List[str]] = None
+                         ) -> List[Dict[str, object]]:
+    specs = (LAUNCH_BUDGETS if names is None
+             else [budget_by_name(n) for n in names])
+    return [b.check() for b in specs]
+
+
+# ---------------------------------------------------------------------------
+# zero-recompile guarantees
+# ---------------------------------------------------------------------------
+
+
+def jit_cache_size(fn) -> int:
+    """Compiled-program count held by a jax.jit wrapper."""
+    size = getattr(fn, "_cache_size", None)
+    if callable(size):
+        return int(size())
+    raise RuntimeError(
+        "this jax version exposes no jit cache-size probe; the recompile "
+        "specs need jax>=0.4 (PjitFunction._cache_size)")
+
+
+def serving_recompile_sweep(max_bucket: int = 64) -> Dict[str, object]:
+    """Sweep every batch size in [1, max_bucket] through the serving
+    runtime; the bucket ladder bounds compiles at log2(max_bucket)+1 and
+    a second identical sweep must compile NOTHING."""
+    import numpy as np
+
+    rt = None
+    try:
+        from ..serving.runtime import PredictorRuntime
+
+        rt = PredictorRuntime(tiny_packed_forest(), max_bucket=max_bucket,
+                              donate=False)
+        rng = np.random.RandomState(0)
+        sizes = sorted({1, 2, 3, max_bucket}
+                       | {int(x) for x in rng.randint(1, max_bucket + 1,
+                                                      size=12)})
+        for n in sizes:
+            rt.predict(rng.randn(n, rt.packed.num_feature()))
+        first = rt.num_compiles
+        for n in sizes:
+            rt.predict(rng.randn(n, rt.packed.num_feature()))
+        second = rt.num_compiles - first
+    finally:
+        del rt
+    limit = max_bucket.bit_length()                # log2(max_bucket) + 1
+    return {"name": f"serving_sweep_b{max_bucket}",
+            "compiles": first, "recompiles_on_repeat": second,
+            "max_compiles": limit,
+            "ok": first <= limit and second == 0,
+            "note": "bucket ladder: <= log2(max_bucket)+1 programs, "
+                    "repeat sweep hits cache only"}
+
+
+def fused_train_step_recompiles(n_hyper_batches: int = 3
+                                ) -> Dict[str, object]:
+    """Drive the fused-CV train step with ``n_hyper_batches`` different
+    hyper-parameter batches (and segment bounds) at one data shape: the
+    r6 invariant is that hyperparameters and seg_end are TRACED, so the
+    program compiles once and every batch reuses it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..config import parse_params
+    from ..models.fused import _fused_cv_fn
+    from ..models.gbdt import HyperScalars, _objective_static_key
+    from ..objectives import create_objective
+
+    p = parse_params({"objective": "regression"}, warn_unknown=False)
+    obj = create_objective(p)
+    n, num_features, num_bins, num_leaves = 256, 4, 16, 7
+    run_segment, init_carry, _ = _fused_cv_fn(
+        _objective_static_key(obj, p), num_leaves, num_bins,
+        "l2", float(p.alpha), float(p.tweedie_variance_power),
+        t_max=6, bagging_freq=0, n_configs=1, n_folds=1,
+        hist_impl="auto", row_chunk=131072)
+
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, num_bins, size=(n, num_features)),
+                       jnp.int32)
+    y = jnp.asarray(rng.randn(n).astype(np.float32))
+    w = jnp.ones(n, jnp.float32)
+    masks = jnp.ones((1, n), jnp.float32)
+
+    def hyper(lr: float, l2: float) -> HyperScalars:
+        one = jnp.ones((1,), jnp.float32)
+        return HyperScalars(
+            learning_rate=one * lr, lambda_l1=one * 0.0,
+            lambda_l2=one * l2, min_data_in_leaf=one * 5.0,
+            min_sum_hessian=one * 1e-3, min_gain_to_split=one * 0.0,
+            max_depth=jnp.zeros((1,), jnp.int32),
+            feature_fraction_bynode=one, top_rate=one * 0.2,
+            other_rate=one * 0.1, max_delta_step=one * 0.0,
+            path_smooth=one * 0.0, linear_lambda=one * 0.0)
+
+    before = jit_cache_size(run_segment)
+    for i in range(n_hyper_batches):
+        carry = init_carry(n, jnp.zeros((1,), jnp.float32))
+        carry = carry._replace(bag=masks)
+        carry = run_segment(
+            carry, jnp.int32(2 + i), bins, y, w, masks, masks,
+            hyper(0.05 * (i + 1), 0.1 * i), jnp.ones((1,), jnp.float32),
+            jnp.ones((1,), jnp.float32),
+            jnp.full((1,), float(n), jnp.float32), jnp.int32(0),
+            jnp.zeros((1,), jnp.float32), jax.random.PRNGKey(i))
+        jax.block_until_ready(carry.r)  # graftlint: GL002 — probe sync
+    compiles = jit_cache_size(run_segment) - before
+    # `before` can be nonzero when an identical static config already ran
+    # in-process (the lru_cached builder shares run_segment) — the
+    # invariant is that the SWEEP adds at most one program.
+    return {"name": f"fused_train_step_x{n_hyper_batches}",
+            "compiles": compiles, "max_compiles": 1,
+            "ok": compiles <= 1,
+            "note": "hyperparameters + seg_end traced: one program "
+                    "across hyper-parameter batches"}
+
+
+def check_recompile_specs(serving_max_bucket: int = 64,
+                          n_hyper_batches: int = 3
+                          ) -> List[Dict[str, object]]:
+    return [serving_recompile_sweep(serving_max_bucket),
+            fused_train_step_recompiles(n_hyper_batches)]

@@ -1,0 +1,134 @@
+"""``python -m lightgbm_tpu lint`` — the graftlint front end.
+
+Default run: Layer 1 (AST rules + baseline) and the VMEM estimates —
+fast, no compilation.  ``--budgets`` adds the Layer-2 HLO launch budgets
+and the zero-recompile sweeps (lowers real entry points; ~a minute on
+CPU).  Exit codes: 0 clean, 1 findings/budget violations, 2 usage or
+baseline-format errors.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List, Optional
+
+from .baseline import BaselineError
+from .engine import DEFAULT_BASELINE, run_lint
+
+_USAGE = """\
+usage: python -m lightgbm_tpu lint [paths...] [options]
+
+options:
+  --budgets         also run HLO launch budgets + recompile sweeps (slow)
+  --no-vmem         skip the VMEM footprint estimates
+  --no-baseline     report accepted debt too (ratchet view)
+  --baseline PATH   alternate baseline file
+  --format json     machine-readable report on stdout
+  -q, --quiet       findings only, no summary
+"""
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    budgets, vmem = False, True
+    use_baseline = True
+    fmt = "text"
+    quiet = False
+    baseline_path = DEFAULT_BASELINE
+    paths: List[str] = []
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a in ("-h", "--help"):
+            print(_USAGE)
+            return 0
+        if a == "--budgets":
+            budgets = True
+        elif a == "--no-vmem":
+            vmem = False
+        elif a == "--no-baseline":
+            use_baseline = False
+        elif a == "--baseline":
+            i += 1
+            if i >= len(args):
+                print("--baseline needs a path", file=sys.stderr)
+                return 2
+            baseline_path = args[i]
+        elif a == "--format":
+            i += 1
+            if i >= len(args) or args[i] not in ("text", "json"):
+                print("--format takes text|json", file=sys.stderr)
+                return 2
+            fmt = args[i]
+        elif a in ("-q", "--quiet"):
+            quiet = True
+        elif a.startswith("-"):
+            print(f"unknown option {a!r}\n{_USAGE}", file=sys.stderr)
+            return 2
+        else:
+            paths.append(a)
+        i += 1
+
+    try:
+        report = run_lint(paths or None,
+                          baseline_path if use_baseline else None)
+    except BaselineError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    sections = {"layer1": {
+        "files_checked": report.files_checked,
+        "unsuppressed": [f.format() for f in report.unsuppressed],
+        "suppressed": [f.format() for f in report.suppressed],
+        "stale_suppressions": [
+            f"{s.rule} {s.path} (count {s.count}, used {s.used}): "
+            f"{s.reason}" for s in report.stale],
+    }}
+    failed = bool(report.unsuppressed)
+
+    if vmem:
+        from .vmem import check_vmem_specs
+
+        res = check_vmem_specs()
+        sections["vmem"] = res
+        failed |= any(not r["ok"] for r in res)
+
+    if budgets:
+        from .budgets import check_launch_budgets, check_recompile_specs
+
+        res = check_launch_budgets()
+        sections["launch_budgets"] = res
+        failed |= any(not r["ok"] for r in res)
+        res = check_recompile_specs()
+        sections["recompile"] = res
+        failed |= any(not r["ok"] for r in res)
+
+    if fmt == "json":
+        sections["ok"] = not failed
+        print(json.dumps(sections, indent=1))
+        return 1 if failed else 0
+
+    l1 = sections["layer1"]
+    for line in l1["unsuppressed"]:
+        print(line)
+    if not quiet:
+        for line in l1["stale_suppressions"]:
+            print(f"stale baseline entry: {line}")
+        for key in ("vmem", "launch_budgets", "recompile"):
+            for r in sections.get(key, ()):
+                mark = "ok" if r["ok"] else "FAIL"
+                detail = (f"{r['estimated_mb']}/{r['budget_mb']} MB"
+                          if key == "vmem" else
+                          f"{r.get('measured', r.get('compiles'))}"
+                          f"/{r.get('budget', r.get('max_compiles'))}")
+                print(f"[{mark}] {key}:{r['name']} {detail}")
+        n_unsup = len(l1["unsuppressed"])
+        layers = (["vmem"] if vmem else []) + (
+            ["launch budgets", "recompile sweeps"] if budgets else [])
+        print(f"graftlint: {l1['files_checked']} files, {n_unsup} "
+              f"finding(s), {len(l1['suppressed'])} baselined"
+              + (f"; {' + '.join(layers)} "
+                 + ("FAILED" if failed and not n_unsup else "ok")
+                 if layers else ""))
+    return 1 if failed else 0

@@ -1,0 +1,88 @@
+"""graftlint driver: walk the package, run Layer 1, apply the baseline.
+
+The engine is deliberately import-free with respect to JAX — Layer 1 is
+pure ``ast`` so ``lint`` stays fast (and runnable on machines with no
+accelerator stack at all).  Layer 2 (budgets/vmem) lives in
+``analysis.budgets`` / ``analysis.vmem`` and is pulled in by the CLI only
+when asked.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Iterable, List, Optional
+
+from .baseline import (BaselineResult, Suppression, apply_baseline,
+                       parse_baseline)
+from .rules import Finding, analyze_source
+
+# Directories never linted: fixtures are deliberately-broken snippets,
+# __pycache__ is noise.
+_SKIP_DIRS = {"__pycache__", "fixtures", ".git"}
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_BASELINE = os.path.join(_HERE, "baseline.toml")
+PACKAGE_ROOT = os.path.dirname(_HERE)          # lightgbm_tpu/
+REPO_ROOT = os.path.dirname(PACKAGE_ROOT)
+
+
+def iter_py_files(roots: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for root in roots:
+        if os.path.isfile(root):
+            out.append(root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def rel_path(path: str) -> str:
+    """Repo-relative posix path — the canonical anchor form findings and
+    baseline entries use, so the baseline is machine-independent."""
+    ap = os.path.abspath(path)
+    if ap.startswith(REPO_ROOT + os.sep):
+        ap = ap[len(REPO_ROOT) + 1:]
+    return ap.replace(os.sep, "/")
+
+
+@dataclass
+class LintReport:
+    files_checked: int = 0
+    findings: List[Finding] = field(default_factory=list)
+    unsuppressed: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    stale: List[Suppression] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.unsuppressed
+
+
+def run_lint(paths: Optional[Iterable[str]] = None,
+             baseline_path: Optional[str] = DEFAULT_BASELINE) -> LintReport:
+    """Lint ``paths`` (default: the installed package tree) and fold in
+    the baseline.  ``baseline_path=None`` disables suppression."""
+    if paths is None:
+        paths = [PACKAGE_ROOT]
+    report = LintReport()
+    for path in iter_py_files(paths):
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        report.findings.extend(analyze_source(rel_path(path), src))
+        report.files_checked += 1
+    report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    suppressions: List[Suppression] = []
+    if baseline_path and os.path.exists(baseline_path):
+        with open(baseline_path, encoding="utf-8") as f:
+            suppressions = parse_baseline(f.read())
+    res: BaselineResult = apply_baseline(report.findings, suppressions)
+    report.unsuppressed = res.unsuppressed
+    report.suppressed = res.suppressed
+    report.stale = res.stale
+    return report

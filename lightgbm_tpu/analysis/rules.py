@@ -1,0 +1,665 @@
+"""graftlint Layer 1 — AST rules for the workbench's JAX footguns.
+
+Every rule answers one question the type system cannot: "does this code
+keep the invariants rounds 6-7 paid for?"  The detectors are deliberately
+HIGH-PRECISION heuristics: a finding should be either a real bug or a
+deliberate decision worth a baseline entry — a linter the tree cannot keep
+green gets deleted, not obeyed.
+
+Traced-context model
+--------------------
+A function is *traced* when JAX (not Python) runs its body:
+
+* decorated with ``jax.jit`` / ``jax.vmap`` / ``functools.partial(jax.jit,
+  ...)`` / ``pl.when(...)`` and friends;
+* its name appears inside the arguments of a tracing call
+  (``jax.jit(f)``, ``lax.scan(f, ...)``, ``pl.pallas_call(partial(f,
+  ...), ...)``, ``jax.vmap(f)(x)``, ...);
+* it is lexically nested in a traced function; or
+* it is called from a traced function in the same module (tracing is
+  transitive through plain Python calls).
+
+A function is additionally a *kernel* when it reaches ``pl.pallas_call``
+or takes ``*_ref`` parameters — kernels get the dtype-discipline rules.
+
+See analysis/RULES.md for one bad/good example per rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+# call targets (final attribute name) that trace their function arguments
+TRACING_CALLS = {
+    "jit", "pjit", "vmap", "pmap", "grad", "value_and_grad", "hessian",
+    "scan", "while_loop", "fori_loop", "cond", "switch", "associative_scan",
+    "pallas_call", "custom_jvp", "custom_vjp", "checkpoint", "remat",
+    "shard_map", "xmap", "named_call", "when",
+}
+
+# decorators (final attribute name) that make the decorated def traced
+TRACING_DECORATORS = TRACING_CALLS - {"scan", "while_loop", "fori_loop",
+                                      "cond", "switch"}
+
+# attribute calls that force a device->host synchronization
+HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready", "copy_to_host"}
+
+# numpy-namespace roots — numpy ops on tracers either crash or silently
+# concretize
+NUMPY_ROOTS = {"np", "numpy", "onp"}
+
+JAX_EXPR_ROOTS = {"jnp", "jax", "lax", "pl", "pltpu"}
+
+# jax-namespace calls that return HOST constants (fixed at trace time) —
+# branching on these is fine
+HOST_CONSTANT_JAX_CALLS = {
+    "default_backend", "devices", "local_devices", "device_count",
+    "local_device_count", "process_index", "process_count",
+}
+
+KERNEL_DOT_CALLS = {"dot_general", "dot", "matmul", "einsum"}
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    @property
+    def anchor(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"{self.message}")
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers
+# ---------------------------------------------------------------------------
+def _attr_chain(node: ast.AST) -> List[str]:
+    """['jax', 'numpy', 'asarray'] for jax.numpy.asarray; [] if not a
+    plain dotted name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """The base Name an expression is built on (x for x[0].T.foo())."""
+    while True:
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, (ast.Attribute, ast.Subscript, ast.Starred)):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        else:
+            return None
+
+
+def _call_target(call: ast.Call) -> Tuple[Optional[str], List[str]]:
+    """(final attr name, full dotted chain) of a call's callee."""
+    chain = _attr_chain(call.func)
+    if chain:
+        return chain[-1], chain
+    if isinstance(call.func, ast.Name):
+        return call.func.id, [call.func.id]
+    return None, []
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _ordered_walk(node: ast.AST, skip_funcs: bool = True) -> Iterator[ast.AST]:
+    """Pre-order, source-order walk that (optionally) does not descend
+    into nested function definitions."""
+    for child in ast.iter_child_nodes(node):
+        if skip_funcs and isinstance(child, _FUNC_NODES):
+            continue
+        yield child
+        yield from _ordered_walk(child, skip_funcs)
+
+
+def _static_names_from_call(call: ast.Call) -> Set[str]:
+    """Parameter names a jit call marks static (literal forms only)."""
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.add(e.value)
+    return out
+
+
+def _is_jit_chain(node: ast.AST) -> bool:
+    chain = _attr_chain(node)
+    return bool(chain) and chain[-1] in ("jit", "pjit")
+
+
+# ---------------------------------------------------------------------------
+# scope collection
+# ---------------------------------------------------------------------------
+@dataclass
+class _FuncInfo:
+    node: ast.AST
+    name: str                       # '' for lambdas
+    parent: Optional["_FuncInfo"]
+    params: Set[str] = field(default_factory=set)
+    traced: bool = False
+    kernel: bool = False
+    static_params: Set[str] = field(default_factory=set)
+    jit_decorated: bool = False
+    calls: Set[str] = field(default_factory=set)   # bare local names called
+
+    def body_stmts(self) -> List[ast.AST]:
+        if isinstance(self.node, ast.Lambda):
+            return [self.node.body]
+        return list(self.node.body)
+
+    def own_nodes(self) -> Iterator[ast.AST]:
+        """Every node of this function's body, nested defs excluded."""
+        for stmt in self.body_stmts():
+            yield stmt
+            yield from _ordered_walk(stmt)
+
+
+class _Scoper(ast.NodeVisitor):
+    """Collect every function-like node with parent links + local calls."""
+
+    def __init__(self) -> None:
+        self.funcs: List[_FuncInfo] = []
+        self._stack: List[_FuncInfo] = []
+        self.by_name: Dict[str, List[_FuncInfo]] = {}
+
+    @staticmethod
+    def _params_of(node) -> Set[str]:
+        a = node.args
+        names = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return set(names)
+
+    def _enter(self, node, name: str) -> None:
+        info = _FuncInfo(node=node, name=name,
+                         parent=self._stack[-1] if self._stack else None,
+                         params=self._params_of(node))
+        self.funcs.append(info)
+        if name:
+            self.by_name.setdefault(name, []).append(info)
+        self._stack.append(info)
+
+    def visit_FunctionDef(self, node):
+        self._enter(node, node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._enter(node, "")
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_Call(self, node):
+        if self._stack:
+            tgt, chain = _call_target(node)
+            if tgt and len(chain) == 1:
+                self._stack[-1].calls.add(tgt)
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# per-module analysis
+# ---------------------------------------------------------------------------
+class _ModuleAnalysis:
+    """Traced/kernel closure + rule dispatch for one module."""
+
+    def __init__(self, path: str, tree: ast.Module,
+                 kernel_file: bool) -> None:
+        self.path = path
+        self.tree = tree
+        self.kernel_file = kernel_file
+        self.findings: List[Finding] = []
+        scoper = _Scoper()
+        scoper.visit(tree)
+        self.funcs = scoper.funcs
+        self.by_name = scoper.by_name
+        self._mark_roots()
+        self._close_traced()
+
+    # -- traced/kernel closure ----------------------------------------------
+    def _decorator_names(self, dec: ast.AST) -> Set[str]:
+        """All dotted-name components a decorator expression mentions."""
+        names = set(_attr_chain(dec))
+        if isinstance(dec, ast.Call):
+            tgt, chain = _call_target(dec)
+            names |= set(chain)
+            if tgt:
+                names.add(tgt)
+            for a in dec.args:
+                names |= set(_attr_chain(a))
+        return names
+
+    def _mark_roots(self) -> None:
+        for info in self.funcs:
+            if isinstance(info.node, ast.Lambda):
+                continue
+            for dec in info.node.decorator_list:
+                names = self._decorator_names(dec)
+                if not (names & TRACING_DECORATORS):
+                    continue
+                info.traced = True
+                if names & {"jit", "pjit"}:
+                    info.jit_decorated = True
+                    if isinstance(dec, ast.Call):
+                        info.static_params |= _static_names_from_call(dec)
+                if names & {"when", "pallas_call"}:
+                    info.kernel = True
+            # *_ref params are the Pallas kernel calling convention
+            if sum(p.endswith("_ref") for p in info.params) >= 2:
+                info.kernel = True
+                info.traced = True
+        # names referenced inside the arguments of tracing calls
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            tgt, _ = _call_target(call)
+            if tgt not in TRACING_CALLS:
+                continue
+            referenced: Set[str] = set()
+            for a in list(call.args) + [kw.value for kw in call.keywords]:
+                referenced |= _names_in(a)
+            statics = (_static_names_from_call(call)
+                       if tgt in ("jit", "pjit") else set())
+            for name in referenced:
+                for info in self.by_name.get(name, []):
+                    info.traced = True
+                    if tgt == "pallas_call":
+                        info.kernel = True
+                    if tgt in ("jit", "pjit"):
+                        info.jit_decorated = True
+                        info.static_params |= statics
+
+    def _close_traced(self) -> None:
+        # lexical nesting + intra-module call graph, to a fixed point
+        changed = True
+        while changed:
+            changed = False
+            for info in self.funcs:
+                if not info.traced and info.parent is not None \
+                        and info.parent.traced:
+                    info.traced = True
+                    info.kernel = info.kernel or info.parent.kernel
+                    changed = True
+                if info.traced:
+                    for callee in info.calls:
+                        for ci in self.by_name.get(callee, []):
+                            if not ci.traced:
+                                ci.traced = True
+                                ci.kernel = ci.kernel or info.kernel
+                                changed = True
+
+    # -- helpers -------------------------------------------------------------
+    def traced_param_roots(self, info: _FuncInfo) -> Set[str]:
+        """Formal params of this + enclosing traced functions — the names
+        that carry tracers."""
+        roots: Set[str] = set()
+        cur: Optional[_FuncInfo] = info
+        while cur is not None:
+            if cur.traced:
+                roots |= cur.params
+            cur = cur.parent
+        return roots
+
+    def emit(self, rule: str, node: ast.AST, message: str) -> None:
+        self.findings.append(Finding(rule, self.path, node.lineno,
+                                     node.col_offset, message))
+
+    # -- rule dispatch -------------------------------------------------------
+    def run(self) -> List[Finding]:
+        for info in self.funcs:
+            if info.traced:
+                self._rule_traced_branch(info)
+                self._rule_host_sync(info)
+            if info.kernel:
+                self._rule_kernel_dot(info)
+            self._rule_static_args(info)
+            self._rule_inplace_mutation(info)
+            self._rule_donate_reuse(info)
+        self._rule_static_args_callsites()
+        self._rule_host_sync_global()
+        self._rule_f64()
+        return self.findings
+
+    # -- GL001: Python control flow on traced values -------------------------
+    def _rule_traced_branch(self, info: _FuncInfo) -> None:
+        for node in info.own_nodes():
+            if not isinstance(node, (ast.If, ast.While, ast.IfExp,
+                                     ast.Assert)):
+                continue
+            for sub in ast.walk(node.test):
+                if isinstance(sub, ast.Call):
+                    tgt, chain = _call_target(sub)
+                    if tgt in HOST_CONSTANT_JAX_CALLS:
+                        continue
+                    if chain and chain[0] in JAX_EXPR_ROOTS:
+                        kind = ("while" if isinstance(node, ast.While)
+                                else "assert" if isinstance(node, ast.Assert)
+                                else "if")
+                        self.emit(
+                            "GL001", node,
+                            f"Python `{kind}` branches on a traced value "
+                            f"({'.'.join(chain)}(...)) inside traced code "
+                            f"— use lax.cond/lax.select/jnp.where, or "
+                            f"hoist the decision to trace time")
+                        break
+
+    # -- GL002: host syncs inside traced code --------------------------------
+    def _rule_host_sync(self, info: _FuncInfo) -> None:
+        tracer_roots = self.traced_param_roots(info)
+        for node in info.own_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            tgt, chain = _call_target(node)
+            if tgt in HOST_SYNC_METHODS and tgt != "block_until_ready" \
+                    and isinstance(node.func, ast.Attribute):
+                self.emit("GL002", node,
+                          f"`.{tgt}()` forces a device sync inside traced "
+                          f"code — return the value and sync at the host "
+                          f"boundary")
+                continue
+            if chain in (["jax", "device_get"], ["device_get"]):
+                self.emit("GL002", node,
+                          "jax.device_get inside traced code is a host "
+                          "sync — keep data on device until dispatch "
+                          "returns")
+                continue
+            if not node.args:
+                continue
+            arg_root = _root_name(node.args[0])
+            if arg_root not in tracer_roots:
+                continue
+            if chain and chain[0] in NUMPY_ROOTS and tgt in (
+                    "asarray", "array", "copy", "ascontiguousarray",
+                    "savetxt"):
+                self.emit("GL002", node,
+                          f"np.{tgt} on traced value `{arg_root}` "
+                          f"materializes it on host — use the jnp "
+                          f"equivalent or keep the op in XLA")
+            elif len(chain) == 1 and tgt in ("float", "int", "bool"):
+                self.emit("GL002", node,
+                          f"`{tgt}()` on traced value `{arg_root}` "
+                          f"concretizes the tracer (host sync or trace "
+                          f"error) — use .astype or keep it symbolic")
+
+    # -- GL002 (module scope): syncs that matter anywhere --------------------
+    def _rule_host_sync_global(self) -> None:
+        """Two sync forms flagged regardless of traced context: they only
+        appear on dispatch/warm/benchmark paths, where each use is either
+        a bug or a deliberate boundary worth a baseline line."""
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tgt, chain = _call_target(node)
+            if tgt == "block_until_ready":
+                self.emit(
+                    "GL002", node,
+                    "block_until_ready stalls the host until the device "
+                    "drains — only warm-up / timing code should do this, "
+                    "and it should be ledgered in the baseline")
+            elif chain and chain[0] in NUMPY_ROOTS and \
+                    tgt in ("asarray", "array") and node.args:
+                for sub in ast.walk(node.args[0]):
+                    if isinstance(sub, ast.Call):
+                        _, sc = _call_target(sub)
+                        if sc and sc[0] in ("jnp", "lax"):
+                            self.emit(
+                                "GL002", node,
+                                f"np.{tgt} over a device expression "
+                                f"materializes it on host (blocking "
+                                f"dispatch) — sync only at the API "
+                                f"boundary, and ledger that boundary in "
+                                f"the baseline")
+                            break
+
+    # -- GL003: float64 traps in accelerator code ----------------------------
+    def _rule_f64(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Attribute) and node.attr == "float64":
+                chain = _attr_chain(node)
+                is_jnp = bool(chain) and chain[0] in JAX_EXPR_ROOTS
+                if self.kernel_file or is_jnp:
+                    self.emit(
+                        "GL003", node,
+                        f"{'.'.join(chain) or 'float64'} in accelerator "
+                        f"code: TPUs have no f64 ALU — under default "
+                        f"config this silently truncates to f32, under "
+                        f"x64 it breaks the kernel dtype contract; name "
+                        f"an explicit f32/bf16 width")
+            elif isinstance(node, ast.Call):
+                tgt, chain = _call_target(node)
+                if chain[-2:] == ["config", "update"] and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        node.args[0].value == "jax_enable_x64":
+                    self.emit(
+                        "GL003", node,
+                        "jax_enable_x64 flips every default dtype to f64 "
+                        "process-wide — the workbench's kernels and "
+                        "packed formats are f32-only")
+                elif tgt == "astype" and self.kernel_file and node.args \
+                        and isinstance(node.args[0], ast.Name) and \
+                        node.args[0].id == "float":
+                    self.emit(
+                        "GL003", node,
+                        ".astype(float) means f64 under numpy semantics "
+                        "— name the width (jnp.float32)")
+
+    # -- GL004: static_argnames discipline -----------------------------------
+    def _rule_static_args(self, info: _FuncInfo) -> None:
+        if isinstance(info.node, ast.Lambda):
+            return
+        # (a) static_argnames naming a parameter the function doesn't have
+        for dec in info.node.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            tgt, _ = _call_target(dec)
+            is_partial_jit = (tgt == "partial"
+                              and any(_is_jit_chain(a) for a in dec.args))
+            if tgt in ("jit", "pjit") or is_partial_jit:
+                for name in sorted(_static_names_from_call(dec)):
+                    if name not in info.params:
+                        self.emit(
+                            "GL004", dec,
+                            f"static_argnames names `{name}` but "
+                            f"`{info.name}` has no such parameter — jit "
+                            f"raises (or silently ignores it) at call "
+                            f"time")
+        # (b) jitted def consuming a param where Python needs a concrete
+        # value, without marking it static
+        if not info.jit_decorated:
+            return
+        dynamic = info.params - info.static_params - {"self"}
+        for node in info.own_nodes():
+            if isinstance(node, ast.Call):
+                tgt, chain = _call_target(node)
+                if tgt == "range" and len(chain) == 1:
+                    for a in node.args:
+                        root = _root_name(a)
+                        if root in dynamic:
+                            self.emit(
+                                "GL004", node,
+                                f"`range({root})` inside jitted "
+                                f"`{info.name}` needs a concrete value — "
+                                f"add `{root}` to static_argnames or use "
+                                f"lax.fori_loop")
+
+    def _rule_static_args_callsites(self) -> None:
+        """jax.jit(f, static_argnames=...) where f is a visible local def
+        lacking that parameter."""
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call) or not _is_jit_chain(
+                    call.func):
+                continue
+            statics = _static_names_from_call(call)
+            if not statics or not call.args or not isinstance(
+                    call.args[0], ast.Name):
+                continue
+            for target in self.by_name.get(call.args[0].id, []):
+                for name in sorted(statics):
+                    if name not in target.params:
+                        self.emit(
+                            "GL004", call,
+                            f"static_argnames names `{name}` but "
+                            f"`{target.name}` has no such parameter — jit "
+                            f"raises (or silently ignores it) at call "
+                            f"time")
+
+    # -- GL005: in-place numpy mutation of jax arrays ------------------------
+    def _rule_inplace_mutation(self, info: _FuncInfo) -> None:
+        jax_names: Set[str] = set()
+        for node in info.own_nodes():
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tname = node.targets[0].id
+                if isinstance(node.value, ast.Call):
+                    _, chain = _call_target(node.value)
+                    if chain and chain[0] in ("jnp", "jax", "lax"):
+                        jax_names.add(tname)
+                        continue
+                jax_names.discard(tname)
+                continue
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Subscript):
+                target = node.targets[0]
+            elif isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Subscript):
+                target = node.target
+            if target is not None:
+                root = _root_name(target)
+                if root in jax_names and not root.endswith("_ref"):
+                    self.emit(
+                        "GL005", node,
+                        f"in-place `{root}[...] = ...` on a jax array — "
+                        f"jax arrays are immutable (this raises at "
+                        f"runtime); use `.at[...].set(...)`")
+
+    # -- GL006: donated buffers reused after dispatch ------------------------
+    def _rule_donate_reuse(self, info: _FuncInfo) -> None:
+        if isinstance(info.node, ast.Lambda):
+            return
+        donating: Dict[str, Tuple[int, ...]] = {}
+        donated: Dict[str, int] = {}            # var -> donation line
+        skip_nodes: Set[int] = set()            # Name nodes of the donation
+        for node in info.own_nodes():
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call) \
+                    and _is_jit_chain(node.value.func):
+                nums: Tuple[int, ...] = ()
+                for kw in node.value.keywords:
+                    if kw.arg == "donate_argnums":
+                        v = kw.value
+                        if isinstance(v, ast.Constant) and isinstance(
+                                v.value, int):
+                            nums = (v.value,)
+                        elif isinstance(v, (ast.Tuple, ast.List)):
+                            nums = tuple(
+                                e.value for e in v.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, int))
+                if nums:
+                    donating[node.targets[0].id] = nums
+            elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Name) and node.func.id in donating:
+                for pos in donating[node.func.id]:
+                    if pos < len(node.args):
+                        root = _root_name(node.args[pos])
+                        if root is not None:
+                            donated.setdefault(root, node.lineno)
+                            for sub in ast.walk(node.args[pos]):
+                                skip_nodes.add(id(sub))
+            elif isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load) and node.id in donated \
+                    and id(node) not in skip_nodes:
+                self.emit(
+                    "GL006", node,
+                    f"`{node.id}` was donated to a jitted call (line "
+                    f"{donated[node.id]}) and is read again — the buffer "
+                    f"may already be aliased to the output (garbage on "
+                    f"TPU)")
+                del donated[node.id]
+
+    # -- GL007: kernel dots without explicit accumulation dtype --------------
+    def _rule_kernel_dot(self, info: _FuncInfo) -> None:
+        for node in info.own_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            tgt, chain = _call_target(node)
+            if tgt in KERNEL_DOT_CALLS and chain and \
+                    chain[0] in ("lax", "jnp", "jax"):
+                if not any(kw.arg == "preferred_element_type"
+                           for kw in node.keywords):
+                    self.emit(
+                        "GL007", node,
+                        f"{'.'.join(chain)} in kernel code without "
+                        f"preferred_element_type — the accumulation "
+                        f"dtype follows operand promotion (bf16 operands "
+                        f"accumulate in bf16: silent precision loss on "
+                        f"the MXU)")
+
+
+RULE_IDS = ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007")
+
+
+_KERNEL_FILE_RE = re.compile(
+    r"pallas_call\(|from jax\.experimental import pallas|"
+    r"import pallas_tpu|jax\.experimental\.pallas")
+
+
+def is_kernel_file(src: str) -> bool:
+    """A module that DEFINES Pallas kernels (not one that merely calls a
+    wrapper from a kernel module) gets the dtype-discipline rules."""
+    return bool(_KERNEL_FILE_RE.search(src))
+
+
+def analyze_source(path: str, src: str) -> List[Finding]:
+    """Run every Layer-1 rule over one module's source."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding("GL000", path, e.lineno or 1, 0,
+                        f"syntax error: {e.msg}")]
+    analysis = _ModuleAnalysis(path, tree, is_kernel_file(src))
+    findings = analysis.run()
+    # inline waivers: `# graftlint: GLxxx — reason` on the finding's line
+    lines = src.splitlines()
+    kept = []
+    for f in findings:
+        line = lines[f.line - 1] if f.line - 1 < len(lines) else ""
+        if "graftlint:" in line:
+            waiver = line.split("graftlint:", 1)[1]
+            if f.rule in waiver or "off" in waiver:
+                continue
+        kept.append(f)
+    return sorted(kept, key=lambda f: (f.path, f.line, f.rule))

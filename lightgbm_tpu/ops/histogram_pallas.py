@@ -597,6 +597,14 @@ def split_iter_pallas(hist2_t: jnp.ndarray, table: jnp.ndarray,
     """
     capacity, nc = table.shape
     _, num_features, _, num_bins = hist2_t.shape
+    if max(capacity, num_bins) > 1 << 24:
+        # the packed table and the aux pick carry node ids / feature ids /
+        # bin thresholds as f32 lanes — exact only below 2^24 (checked
+        # rather than silently rounding the tree structure)
+        raise ValueError(
+            f"split_iter_pallas packs indices into f32 lanes; capacity="
+            f"{capacity} / num_bins={num_bins} exceeds the f32-exact "
+            f"integer range (2^24)")
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     return pl.pallas_call(
@@ -820,6 +828,14 @@ def hist_partition_fused_pallas(
     f_rows, n_pad = bins_t.shape
     if num_features is None:
         num_features = f_rows
+    if num_bins > 1 << 24:
+        # the routing phase widens i32 bin codes to f32 for the in-VMEM
+        # threshold compare (codes live on the 128-lane minor axis, where
+        # Mosaic has no i32 select) — exact only while codes < 2^24, so
+        # the widening is CHECKED here instead of silently lossy
+        raise ValueError(
+            f"num_bins={num_bins} exceeds the f32-exact integer range "
+            f"(2^24) used by the fused partition routing")
     s = stats_t.shape[0]
     k = num_segments * s
     n_chunks = n_pad // chunk

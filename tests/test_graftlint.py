@@ -1,0 +1,362 @@
+"""graftlint's own test suite (r8 tentpole).
+
+Three layers of coverage:
+
+* seeded violations — one minimal snippet per rule ID, asserting the
+  rule fires at exactly the expected line (and nowhere else), plus
+  negative twins asserting the clean spelling stays silent;
+* the baseline machinery — TOML-subset parsing, count-based
+  suppression, stale-entry reporting, format errors;
+* the gates themselves — the package tree lints clean through the real
+  CLI, the VMEM estimates fit the 16 MB scope, and the zero-recompile
+  guarantees hold (serving bucket ladder, fused train step).
+"""
+
+import pytest
+
+from lightgbm_tpu.analysis.baseline import (BaselineError, apply_baseline,
+                                            parse_baseline)
+from lightgbm_tpu.analysis.cli import main as lint_main
+from lightgbm_tpu.analysis.engine import run_lint
+from lightgbm_tpu.analysis.rules import analyze_source
+
+
+def findings(src, path="fix.py"):
+    return analyze_source(path, src)
+
+
+def rules_at(src, rule):
+    """Sorted line numbers where ``rule`` fires."""
+    return [f.line for f in findings(src) if f.rule == rule]
+
+
+def line_of(src, needle):
+    for i, text in enumerate(src.splitlines(), 1):
+        if needle in text:
+            return i
+    raise AssertionError(f"{needle!r} not in fixture")
+
+
+# ---------------------------------------------------------------------------
+# seeded violations, one per rule
+# ---------------------------------------------------------------------------
+
+GL001_BAD = """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    if jnp.sum(x) > 0:
+        return x
+    return -x
+"""
+
+GL001_GOOD = """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    return jnp.where(jnp.sum(x) > 0, x, -x)
+"""
+
+
+def test_gl001_traced_branch():
+    assert rules_at(GL001_BAD, "GL001") == [line_of(GL001_BAD, "if ")]
+    assert rules_at(GL001_GOOD, "GL001") == []
+
+
+def test_gl001_host_constant_backend_is_clean():
+    src = GL001_BAD.replace("jnp.sum(x) > 0",
+                            'jax.default_backend() == "tpu"')
+    assert rules_at(src, "GL001") == []
+
+
+GL002_BAD = """\
+import jax
+import numpy as np
+
+@jax.jit
+def f(x):
+    y = x * 2
+    return y.item()
+
+def g(x):
+    return jax.lax.scan(lambda c, v: (c + float(x), v), 0.0, x)
+"""
+
+
+def test_gl002_host_sync():
+    lines = rules_at(GL002_BAD, "GL002")
+    assert line_of(GL002_BAD, ".item()") in lines
+
+
+def test_gl002_np_asarray_on_traced_param():
+    src = ("import jax\nimport numpy as np\n\n@jax.jit\n"
+           "def f(x):\n    return np.asarray(x)\n")
+    assert rules_at(src, "GL002") == [6]
+    # np.asarray of plain host data in untraced code is fine
+    clean = "import numpy as np\n\ndef g(rows):\n    return np.asarray(rows)\n"
+    assert rules_at(clean, "GL002") == []
+
+
+def test_gl002_block_until_ready_fires_anywhere():
+    src = ("import jax\n\ndef warm(fn, x):\n"
+           "    jax.block_until_ready(fn(x))\n")
+    assert rules_at(src, "GL002") == [4]
+
+
+def test_gl002_np_asarray_over_device_expression():
+    src = ("import numpy as np\nimport jax.numpy as jnp\n\n"
+           "def dispatch(fn, codes):\n"
+           "    return np.asarray(fn(jnp.asarray(codes)))\n")
+    assert rules_at(src, "GL002") == [5]
+
+
+GL003_BAD = """\
+import jax
+import jax.numpy as jnp
+import functools
+import jax.experimental.pallas as pl
+
+def kern(x_ref, o_ref):
+    o_ref[...] = x_ref[...].astype(jnp.float64)
+
+jax.config.update("jax_enable_x64", True)
+"""
+
+
+def test_gl003_float64_traps():
+    lines = rules_at(GL003_BAD, "GL003")
+    assert line_of(GL003_BAD, "jnp.float64") in lines
+    assert line_of(GL003_BAD, "jax_enable_x64") in lines
+
+
+def test_gl003_silent_in_host_only_module():
+    # np.float64 in a module with no kernels is host-side bookkeeping
+    src = "import numpy as np\n\nout = np.zeros(3, dtype=np.float64)\n"
+    assert rules_at(src, "GL003") == []
+
+
+GL004_BAD = """\
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("num_leaves",))
+def f(x, n):
+    return x
+
+@jax.jit
+def g(x, depth):
+    acc = x
+    for _ in range(depth):
+        acc = acc + 1
+    return acc
+"""
+
+
+def test_gl004_static_argnames():
+    lines = rules_at(GL004_BAD, "GL004")
+    assert line_of(GL004_BAD, "static_argnames") in lines   # no such param
+    assert line_of(GL004_BAD, "range(depth)") in lines      # needs static
+    # naming a real param + marking the loop bound static is clean
+    good = GL004_BAD.replace('("num_leaves",)', '("n",)').replace(
+        "def g(x, depth):",
+        "def g(x, depth):  # graftlint: GL004").replace(
+        "    for _ in range(depth):",
+        "    for _ in range(3):")
+    assert rules_at(good, "GL004") == []
+
+
+GL005_BAD = """\
+import jax.numpy as jnp
+import numpy as np
+
+def f(n):
+    x = jnp.zeros(n)
+    x[0] = 1.0
+    y = np.zeros(n)
+    y[0] = 1.0
+    return x, y
+"""
+
+
+def test_gl005_inplace_mutation():
+    # the jax array assignment fires; the numpy one is legitimate
+    assert rules_at(GL005_BAD, "GL005") == [line_of(GL005_BAD, "x[0]")]
+
+
+GL006_BAD = """\
+import jax
+
+def run(step, params, batch):
+    fast = jax.jit(step, donate_argnums=(0,))
+    out = fast(params, batch)
+    return out, params.sum()
+"""
+
+
+def test_gl006_donated_reuse():
+    assert rules_at(GL006_BAD, "GL006") == [
+        line_of(GL006_BAD, "params.sum()")]
+    good = GL006_BAD.replace("out, params.sum()", "out, out.sum()")
+    assert rules_at(good, "GL006") == []
+
+
+GL007_BAD = """\
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+def matmul_kernel(a_ref, b_ref, o_ref):
+    o_ref[...] = jnp.dot(a_ref[...], b_ref[...])
+"""
+
+
+def test_gl007_kernel_dot_dtype():
+    assert rules_at(GL007_BAD, "GL007") == [line_of(GL007_BAD, "jnp.dot")]
+    good = GL007_BAD.replace(
+        "jnp.dot(a_ref[...], b_ref[...])",
+        "jnp.dot(a_ref[...], b_ref[...], "
+        "preferred_element_type=jnp.float32)")
+    assert rules_at(good, "GL007") == []
+    # the same dot OUTSIDE kernel code is fine (XLA picks f32 there)
+    host = ("import jax.numpy as jnp\n\ndef f(a, b):\n"
+            "    return jnp.dot(a, b)\n")
+    assert rules_at(host, "GL007") == []
+
+
+def test_gl000_syntax_error():
+    fs = findings("def f(:\n")
+    assert [f.rule for f in fs] == ["GL000"]
+
+
+def test_inline_waiver():
+    src = GL007_BAD.replace(
+        "jnp.dot(a_ref[...], b_ref[...])",
+        "jnp.dot(a_ref[...], b_ref[...])  # graftlint: GL007 — bf16 ok")
+    assert rules_at(src, "GL007") == []
+
+
+def test_tracing_closure_through_local_calls():
+    # helper() is traced only because a jitted function calls it
+    src = """\
+import jax
+
+def helper(x):
+    return x.item()
+
+@jax.jit
+def entry(x):
+    return helper(x)
+"""
+    assert rules_at(src, "GL002") == [line_of(src, ".item()")]
+
+
+# ---------------------------------------------------------------------------
+# baseline machinery
+# ---------------------------------------------------------------------------
+
+def test_baseline_parse_and_suppress():
+    sup = parse_baseline("""
+# ledger
+[[suppress]]
+rule = "GL002"
+path = "pkg/mod.py"
+count = 2
+reason = "api boundary"
+""")
+    assert len(sup) == 1 and sup[0].count == 2
+    fs = findings(GL002_BAD, path="pkg/mod.py")
+    gl2 = [f for f in fs if f.rule == "GL002"]
+    res = apply_baseline(gl2[:1], sup)
+    assert not res.unsuppressed and len(res.suppressed) == 1
+    assert res.stale and res.stale[0].used == 1   # count=2, one used
+
+
+def test_baseline_count_exhaustion():
+    sup = parse_baseline('[[suppress]]\nrule = "GL002"\n'
+                         'path = "p.py"\ncount = 1\nreason = "x"\n')
+    fs = findings(GL002_BAD, path="p.py")
+    gl2 = [f for f in fs if f.rule == "GL002"]
+    assert len(gl2) >= 1
+    res = apply_baseline(gl2 + gl2, sup)          # two findings, count=1
+    assert len(res.suppressed) == 1
+    assert len(res.unsuppressed) == len(gl2) * 2 - 1
+
+
+@pytest.mark.parametrize("bad", [
+    "[[other]]\nrule = \"GL001\"\n",              # wrong table name
+    "[suppress]\n",                                # not an array table
+    "rule = \"GL001\"\n",                          # key outside table
+    "[[suppress]]\nrule = \"GL001\"\npath = \"p\"\nreason = \"\"\n",
+    "[[suppress]]\nrule = \"GL001\"\npath = \"p\"\ncount = 0\n"
+    "reason = \"r\"\n",
+    "[[suppress]]\npath = \"p\"\nreason = \"r\"\n",   # missing rule
+])
+def test_baseline_format_errors(bad):
+    with pytest.raises(BaselineError):
+        parse_baseline(bad)
+
+
+# ---------------------------------------------------------------------------
+# the gates themselves
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lint
+def test_package_tree_lints_clean():
+    report = run_lint()
+    assert report.ok, "\n".join(f.format() for f in report.unsuppressed)
+    assert not report.stale, [s.reason for s in report.stale]
+    assert report.files_checked > 30
+
+
+@pytest.mark.lint
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "seeded.py"
+    bad.write_text(GL001_BAD)
+    assert lint_main([str(bad), "--no-vmem", "-q"]) == 1
+    out = capsys.readouterr().out
+    assert "GL001" in out and "seeded.py:6" in out
+    good = tmp_path / "clean.py"
+    good.write_text(GL001_GOOD)
+    assert lint_main([str(good), "--no-vmem", "-q"]) == 0
+
+
+@pytest.mark.lint
+@pytest.mark.parametrize("snippet,rule", [
+    (GL001_BAD, "GL001"), (GL002_BAD, "GL002"), (GL003_BAD, "GL003"),
+    (GL004_BAD, "GL004"), (GL005_BAD, "GL005"), (GL006_BAD, "GL006"),
+    (GL007_BAD, "GL007"),
+], ids=["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007"])
+def test_cli_nonzero_per_seeded_rule(tmp_path, snippet, rule, capsys):
+    p = tmp_path / f"{rule.lower()}.py"
+    p.write_text(snippet)
+    assert lint_main([str(p), "--no-vmem", "-q"]) == 1
+    assert rule in capsys.readouterr().out
+
+
+def test_vmem_specs_fit_budget():
+    from lightgbm_tpu.analysis.vmem import check_vmem_specs
+
+    for r in check_vmem_specs():
+        assert r["ok"], r
+        assert r["estimated_mb"] > 0.1, r      # the model isn't vacuous
+
+
+@pytest.mark.lint
+def test_serving_recompile_sweep():
+    from lightgbm_tpu.analysis.budgets import serving_recompile_sweep
+
+    r = serving_recompile_sweep(max_bucket=64)
+    assert r["ok"], r
+    assert r["compiles"] <= 7 and r["recompiles_on_repeat"] == 0
+
+
+@pytest.mark.lint
+def test_fused_train_step_single_compile():
+    from lightgbm_tpu.analysis.budgets import fused_train_step_recompiles
+
+    r = fused_train_step_recompiles(n_hyper_batches=3)
+    assert r["ok"], r
+    assert r["compiles"] <= 1

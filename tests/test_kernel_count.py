@@ -1,51 +1,32 @@
-"""Kernel-count regression guard (r7 satellite).
+"""Kernel-count regression guard (r7 satellite; declarative since r8).
 
 PERF.md's r4/r5 analysis showed the training floor is kernel LAUNCH
 count (~1,500/round in the fused-CV sweep at ~9 us each), so op-count
 regressions must fail tier-1 instead of surfacing rounds later in a
-bench.  One strict split iteration and one fused-CV-shaped round are
-lowered to compiled HLO on CPU and the growth-loop body's
-fusion/custom-call counts asserted against checked-in budgets
-(measured value + ~25% headroom; see tools/hlo_counts.py for what each
-view means).
+bench.  The budgets themselves are DECLARATIVE specs in
+``lightgbm_tpu.analysis.budgets.LAUNCH_BUDGETS`` (one model shared with
+``python -m lightgbm_tpu lint --budgets`` and the bench artifacts); this
+file is a thin consumer that lowers each spec's entry point and asserts
+``measured <= budget``.
 """
 
 import pytest
 
-from tools.hlo_counts import split_iter_counts
-
-# measured on the r7 jax pin: strict (23 unfused / 45 fused-inlined /
-# 5+1 stub), E-batched (21 / 53 / 5+1).  Budgets leave ~25% headroom.
-BUDGET = {
-    "strict_unfused": 29,
-    "strict_fused_cpu": 56,
-    "strict_tpu_model": 8,
-    "cv_unfused": 27,
-    "cv_fused_cpu": 66,
-    "cv_tpu_model": 8,
-}
+from lightgbm_tpu.analysis.budgets import LAUNCH_BUDGETS, budget_by_name
 
 
-def total(counts):
-    return counts[0] + counts[1]
+@pytest.mark.lint
+@pytest.mark.parametrize("spec", LAUNCH_BUDGETS, ids=lambda s: s.name)
+def test_launch_budget(spec):
+    result = spec.check()
+    assert result["ok"], (
+        f"{spec.name}: measured {result['measured']} launches > budget "
+        f"{spec.budget} ({spec.note})")
 
 
-def test_strict_split_iteration_budgets():
-    assert total(split_iter_counts(False)) <= BUDGET["strict_unfused"]
-    assert total(split_iter_counts(True)) <= BUDGET["strict_fused_cpu"]
-    model = total(split_iter_counts(True, stub=True))
-    assert model <= BUDGET["strict_tpu_model"]
-
-
-def test_fused_cv_round_budgets():
-    # E=8 compiles ~5x faster than the production E=40 bucket and has
-    # IDENTICAL per-iteration body counts (vmapped ops don't multiply
-    # with batch size) — verified against E=40 when the budget was set.
-    e = 8
-    assert total(split_iter_counts(False, e=e)) <= BUDGET["cv_unfused"]
-    assert total(split_iter_counts(True, e=e)) <= BUDGET["cv_fused_cpu"]
-    model = total(split_iter_counts(True, e=e, stub=True))
-    assert model <= BUDGET["cv_tpu_model"]
+@pytest.mark.lint
+def test_r7_tentpole_margin():
     # the r7 tentpole claim: >= 3x launch-count drop per split iteration
     # vs the r4 TPU-measured baseline (49 fusions + 1 custom-call)
+    model = budget_by_name("cv_tpu_model").measure()
     assert model * 3 <= 50

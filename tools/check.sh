@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# One command for the whole gate: style -> graftlint -> budget specs.
+#
+#   tools/check.sh          # style (if ruff present) + lint + vmem
+#   tools/check.sh --full   # also HLO launch budgets + recompile sweeps
+#                           # (lowers real entry points; ~minutes on CPU)
+#
+# Exit: nonzero on the first failing layer.  Tier-1 already runs the
+# same checks through the pytest bridge (`-m lint`); this script is the
+# pre-push / CI front door.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+full=0
+for a in "$@"; do
+  case "$a" in
+    --full) full=1 ;;
+    *) echo "usage: tools/check.sh [--full]" >&2; exit 2 ;;
+  esac
+done
+
+# 1. mechanical style — optional dependency, gated (the TPU container
+#    does not ship ruff; graftlint below runs everywhere)
+if command -v ruff >/dev/null 2>&1; then
+  echo "== ruff =="
+  ruff check .
+else
+  echo "== ruff == (not installed; skipping style layer)"
+fi
+
+# 2. graftlint: AST rules + baseline + VMEM estimates
+echo "== graftlint =="
+JAX_PLATFORMS=cpu python -m lightgbm_tpu lint
+
+# 3. trace-level budgets (slow lane)
+if [ "$full" = 1 ]; then
+  echo "== budgets + recompile sweeps =="
+  JAX_PLATFORMS=cpu python -m lightgbm_tpu lint --budgets -q
+  echo "budget specs ok"
+fi

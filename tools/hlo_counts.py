@@ -1,140 +1,33 @@
-"""Compiled-HLO op-count probes for the growers (r7).
+"""Compiled-HLO op-count probes — thin shim over the graftlint budgets.
 
-The per-round training floor is kernel LAUNCH count, not FLOPs (PERF.md
-r4/r5): the fused-CV sweep ran ~49 fusions + 1 custom-call per split
-iteration before the r7 mega-kernel.  These helpers lower a grower to
-compiled HLO on CPU, find the growth while-loop's body computation, and
-count the fusion / custom-call instructions inside it — one number per
-split iteration.
+The launch-count model (r7) moved into ``lightgbm_tpu.analysis.budgets``
+so the lint gate, the tier-1 tests, and the bench artifacts consume ONE
+model; this module keeps the historical import path
+(``tools.hlo_counts``) and the ``python tools/hlo_counts.py [E]`` CLI.
 
-Two views matter on a CPU-only box:
-
-* ``cpu_body``: what actually compiled here.  Interpret-mode Pallas
-  INLINES the kernel, so the fused body shows MORE fusions on CPU —
-  useful only as a regression pin (tests/test_kernel_count.py).
-* ``stub=True``: the same program with the kernel swapped for a
-  pure_callback (``tree._SPLIT_ITER_OPCOUNT_STUB``) — the body then
-  compiles to the XLA-side fusions plus ONE custom-call, the same
-  launch structure a TPU build has (the mega-kernel is one custom-call
-  on a real backend).  fusions + custom_calls of that body IS the TPU
-  launch model per split iteration.
+See lightgbm_tpu/analysis/budgets.py for what each view means
+(cpu_body vs ``stub=True`` TPU launch model).
 """
 
 from __future__ import annotations
 
-import re
 import sys
-
-import numpy as np
 
 sys.path.insert(0, ".")
 
-import jax
-import jax.numpy as jnp
-
-
-def compiled_text(fn, *args):
-    return jax.jit(fn).lower(*args).compile().as_text()
-
-
-def fusion_count(txt: str) -> int:
-    return len(re.findall(r" fusion\(", txt))
-
-
-def custom_call_count(txt: str) -> int:
-    # instruction form only ("= ... custom-call(...)") — bare
-    # "custom-call" also appears in get-tuple-element operand types
-    return len(re.findall(r" custom-call\(", txt))
-
-
-def while_body_counts(txt: str):
-    """Per while-body (fusions, custom_calls, chars) from compiled HLO."""
-    out = {}
-    for b in set(re.findall(r"body=%?([\w.\-]+)", txt)):
-        m = re.search(r"(?m)^(%?" + re.escape(b)
-                      + r" \([^\n]*\n(?:.*\n)*?)(?=^\}|^%|^ENTRY)", txt)
-        if m:
-            blk = m.group(1)
-            out[b] = (len(re.findall(r" fusion\(", blk)),
-                      len(re.findall(r" custom-call\(", blk)), len(blk))
-    return out
-
-
-def main_body_counts(txt: str):
-    """(fusions, custom_calls) of the LARGEST while body — the growth
-    loop dominates every grower program."""
-    bodies = while_body_counts(txt)
-    if not bodies:
-        return fusion_count(txt), custom_call_count(txt)
-    f, c, _ = max(bodies.values(), key=lambda v: v[2])
-    return f, c
-
-
-def _grow_fixture(num_features=7, num_bins=16, n=4096, e=None, seed=0):
-    rng = np.random.RandomState(seed)
-    bins = jnp.asarray(rng.randint(0, num_bins, size=(n, num_features)),
-                       jnp.int32)
-    shape = (n,) if e is None else (e, n)
-    g = jnp.asarray(rng.randn(*shape).astype(np.float32))
-    ones = jnp.ones(shape, jnp.float32)
-    stats = jnp.stack([g, ones, ones], -1)
-    fmask = jnp.ones(num_features, jnp.float32)
-    return bins, stats, fmask
-
-
-def split_iter_counts(fuse_split: bool, e=None, num_leaves=31,
-                      num_bins=16, n=4096, stub=False):
-    """(fusions, custom_calls) per split iteration of the strict grower
-    (``e=None``) or the E-batched fused-CV tree growth (``e=E``)."""
-    from lightgbm_tpu.models import tree as tree_mod
-    from lightgbm_tpu.models.tree import grow_tree
-    from lightgbm_tpu.ops.split import SplitContext
-
-    bins, stats, fmask = _grow_fixture(num_bins=num_bins, n=n, e=e)
-    ctx = SplitContext(jnp.float32(0.0), jnp.float32(1.0), jnp.float32(3.0),
-                       jnp.float32(1e-3), jnp.float32(0.0))
-
-    def grow(s):
-        return grow_tree(bins, s, fmask, ctx, num_leaves, num_bins, 0,
-                         fuse_split=fuse_split)
-
-    fn = (lambda: grow(stats)) if e is None else (
-        lambda: jax.vmap(grow)(stats))
-    old = tree_mod._SPLIT_ITER_OPCOUNT_STUB
-    tree_mod._SPLIT_ITER_OPCOUNT_STUB = stub and fuse_split
-    try:
-        txt = compiled_text(fn)
-    finally:
-        tree_mod._SPLIT_ITER_OPCOUNT_STUB = old
-    return main_body_counts(txt)
-
-
-def kernels_per_round_summary(e=40, num_leaves=31):
-    """The bench-artifact dict: per-split-iteration launch counts for the
-    fused-CV bucket shape, CPU-measured plus the TPU launch model."""
-    unf_f, unf_c = split_iter_counts(False, e=e, num_leaves=num_leaves)
-    cpu_f, cpu_c = split_iter_counts(True, e=e, num_leaves=num_leaves)
-    xla_f, xla_c = split_iter_counts(True, e=e, num_leaves=num_leaves,
-                                     stub=True)
-    iters = num_leaves - 1
-    model = xla_f + xla_c
-    # r4's TPU-measured per-split-iteration launch count at this bucket
-    # shape (PERF.md "Result: 49 fusions + 1 custom-call per split
-    # iteration"; the "~1,500 kernels/round" exec floor)
-    r4_per_iter = 50
-    return {
-        "split_iter_kernels_r4_baseline": r4_per_iter,
-        "split_iter_kernels_unfused_cpu": unf_f + unf_c,
-        "split_iter_kernels_fused_cpu_inlined": cpu_f + cpu_c,
-        "split_iter_kernels_tpu_model": model,
-        "kernels_per_round_r4_baseline": r4_per_iter * iters,
-        "kernels_per_round_unfused_cpu": (unf_f + unf_c) * iters,
-        "kernels_per_round": model * iters,
-        "kernels_per_round_drop_x": round(r4_per_iter / model, 2),
-        "kernels_per_round_drop_x_vs_cpu_unfused":
-            round((unf_f + unf_c) / model, 2),
-    }
-
+from lightgbm_tpu.analysis.budgets import (  # noqa: E402,F401
+    LAUNCH_BUDGETS,
+    LaunchBudget,
+    check_launch_budgets,
+    compiled_text,
+    custom_call_count,
+    fusion_count,
+    kernels_per_round_summary,
+    main_body_counts,
+    serving_predict_counts,
+    split_iter_counts,
+    while_body_counts,
+)
 
 if __name__ == "__main__":
     import json
