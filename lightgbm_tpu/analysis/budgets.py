@@ -416,3 +416,130 @@ def check_recompile_specs(serving_max_bucket: int = 64,
                           ) -> List[Dict[str, object]]:
     return [serving_recompile_sweep(serving_max_bucket),
             fused_train_step_recompiles(n_hyper_batches)]
+
+
+# ---------------------------------------------------------------------------
+# histogram-merge communication budgets (r9)
+# ---------------------------------------------------------------------------
+#
+# Per-round bytes RECEIVED per shard for one merged histogram wave — the
+# quantity the r9 reduce-scatter tentpole shrinks.  A full psum
+# (allreduce) must deliver the ENTIRE [S, F, B, 3] merged histogram to
+# every shard; a reduce-scatter delivers only that shard's F/D feature
+# slice, because split finding then runs on the slice and only an O(D)
+# BestSplit all-gather follows.  The BestSplit gather is ~64 B/shard and
+# is charged to every mode, so it never flatters the ratio.
+#
+# Ring-transfer view (documented, not budgeted): counting bytes MOVED on
+# the wire per shard, allreduce = 2(D-1)/D * H vs reduce-scatter =
+# (D-1)/D * H — only a 2x drop.  The received-bytes model is the honest
+# one for THIS design because the psum baseline materialises the full
+# histogram in every shard's memory and the split iteration there reads
+# all of it, while the reduce-scatter path never materialises more than
+# the slice.  Both numbers appear in the check result.
+
+
+def hist_merge_comm_bytes(mode: str, n_shards: int, num_features: int,
+                          num_bins: int, num_segments: int,
+                          top_k: int = 20, dtype_bytes: int = 4
+                          ) -> Dict[str, int]:
+    """Modeled communication for ONE merged histogram wave.
+
+    Returns received bytes per shard plus the ring wire-transfer bytes
+    for the same payload.  ``num_segments`` is the wave width (leaves
+    scored per merge); histograms are ``[S, F, B, 3]`` ``dtype_bytes``
+    cells.  ``voting`` charges the votes psum (int32 per feature per
+    segment) plus the reduce-scatter over the padded candidate union
+    ``Kc = min(2*top_k, F)``.
+    """
+    d = max(int(n_shards), 1)
+    cell = num_bins * 3 * dtype_bytes
+    full = num_segments * num_features * cell
+    bestsplit = d * 16 * dtype_bytes       # O(D) BestSplit all-gather
+    if mode == "psum":
+        recv = full
+        wire = (2 * (d - 1) * full) // d
+    elif mode in ("reduce_scatter", "reduce_scatter_ring"):
+        f_pad = -(-num_features // d) * d
+        recv = num_segments * (f_pad // d) * cell
+        wire = ((d - 1) * num_segments * f_pad * cell) // d
+    elif mode == "voting":
+        kc = min(2 * max(int(top_k), 1), num_features)
+        kc_pad = -(-kc // d) * d
+        votes = num_segments * num_features * 4
+        recv = votes + num_segments * (kc_pad // d) * cell
+        wire = (2 * (d - 1) * votes) // d \
+            + ((d - 1) * num_segments * kc_pad * cell) // d
+    else:
+        raise ValueError(f"unknown histogram merge mode {mode!r}")
+    return {"received_bytes_per_shard": recv + bestsplit,
+            "ring_wire_bytes_per_shard": wire + bestsplit}
+
+
+@dataclass(frozen=True)
+class CommBudget:
+    """One merge mode at one reference shape, one minimum drop vs psum.
+
+    Pure arithmetic — no lowering, no devices — so these run in the
+    default ``lint`` pass next to the VMEM estimates.  ``min_drop_x`` is
+    the floor on ``psum_received / mode_received`` at the reference
+    shape; the r9 acceptance bar is >=4x at D=8.
+    """
+
+    name: str
+    mode: str
+    min_drop_x: float
+    n_shards: int = 8
+    num_features: int = 136
+    num_bins: int = 256
+    num_segments: int = 2
+    top_k: int = 20
+    note: str = ""
+
+    def check(self) -> Dict[str, object]:
+        base = hist_merge_comm_bytes(
+            "psum", self.n_shards, self.num_features, self.num_bins,
+            self.num_segments, self.top_k)
+        ours = hist_merge_comm_bytes(
+            self.mode, self.n_shards, self.num_features, self.num_bins,
+            self.num_segments, self.top_k)
+        drop = (base["received_bytes_per_shard"]
+                / ours["received_bytes_per_shard"])
+        return {"name": self.name, "mode": self.mode,
+                "psum_bytes": base["received_bytes_per_shard"],
+                "measured": ours["received_bytes_per_shard"],
+                "ring_wire_bytes": ours["ring_wire_bytes_per_shard"],
+                "budget": int(base["received_bytes_per_shard"]
+                              / self.min_drop_x),
+                "drop_x": round(drop, 2), "min_drop_x": self.min_drop_x,
+                "ok": drop >= self.min_drop_x, "note": self.note}
+
+
+# Reference shape = the r9 acceptance scenario: D=8, ragged F=136
+# (17/shard), B=256, wave of 2 leaves.  psum receives 835,584 B/shard
+# there; reduce-scatter 104,448 B/shard (the F/D slice) — an 8x drop,
+# budgeted at the >=4x acceptance floor so a topology regression (e.g.
+# an accidental all-gather after the scatter) trips the gate before it
+# ships.
+COMM_BUDGETS: Tuple[CommBudget, ...] = (
+    CommBudget("hist_rs_d8", "reduce_scatter", 4.0,
+               note="r9 tentpole: F/D feature slice per shard"),
+    CommBudget("hist_rs_ring_d8", "reduce_scatter_ring", 4.0,
+               note="ppermute ring, same received payload as psum_scatter"),
+    CommBudget("hist_voting_d8", "voting", 4.0,
+               note="PV-Tree: votes psum + 2k-candidate union scatter"),
+)
+
+
+def comm_budget_by_name(name: str) -> CommBudget:
+    for b in COMM_BUDGETS:
+        if b.name == name:
+            return b
+    raise KeyError(name)
+
+
+def check_comm_budgets(names: Optional[List[str]] = None
+                       ) -> List[Dict[str, object]]:
+    specs = (COMM_BUDGETS if names is None
+             else [comm_budget_by_name(n) for n in names])
+    return [b.check() for b in specs]

@@ -94,6 +94,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         sections["vmem"] = res
         failed |= any(not r["ok"] for r in res)
 
+    # pure arithmetic — always on, like the VMEM estimates
+    from .budgets import check_comm_budgets
+
+    res = check_comm_budgets()
+    sections["comm_budgets"] = res
+    failed |= any(not r["ok"] for r in res)
+
     if budgets:
         from .budgets import check_launch_budgets, check_recompile_specs
 
@@ -115,11 +122,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not quiet:
         for line in l1["stale_suppressions"]:
             print(f"stale baseline entry: {line}")
-        for key in ("vmem", "launch_budgets", "recompile"):
+        for key in ("vmem", "comm_budgets", "launch_budgets", "recompile"):
             for r in sections.get(key, ()):
                 mark = "ok" if r["ok"] else "FAIL"
                 detail = (f"{r['estimated_mb']}/{r['budget_mb']} MB"
                           if key == "vmem" else
+                          f"{r['measured']} B ({r['drop_x']}x vs psum, "
+                          f"floor {r['min_drop_x']}x)"
+                          if key == "comm_budgets" else
                           f"{r.get('measured', r.get('compiles'))}"
                           f"/{r.get('budget', r.get('max_compiles'))}")
                 print(f"[{mark}] {key}:{r['name']} {detail}")

@@ -135,6 +135,8 @@ _ALIASES: Dict[str, str] = {
     "goss_top_rate": "top_rate",
     "other_rate": "other_rate",
     "goss_other_rate": "other_rate",
+    "top_k": "top_k",
+    "topk": "top_k",
     "verbosity": "verbosity",
     "verbose": "verbosity",
     "max_bin": "max_bin",
@@ -303,6 +305,9 @@ _FRAMEWORK_KEYS = {
                            # "greedy" (fewest passes) | "half" (near-strict)
     "wave_overgrow",       # exact tail: overgrowth factor (default 2.0)
     "linear_k",            # linear_tree: max path features per leaf model
+    "histogram_merge",     # dp merge topology override: "psum" |
+                           # "reduce_scatter" | "reduce_scatter_ring" |
+                           # "voting" (default follows tree_learner)
 }
 
 _BOOSTING_ALIASES: Dict[str, str] = {
@@ -365,6 +370,9 @@ class Params:
     min_gain_to_split: float = 0.0
     top_rate: float = 0.2
     other_rate: float = 0.1
+    # voting-parallel ballot size (upstream top_k): each shard nominates its
+    # local top_k features by gain; the global top-2k by votes are merged
+    top_k: int = 20
     verbosity: int = 1
     # dataset
     max_bin: int = 255
@@ -572,6 +580,12 @@ def _validate(p: Params) -> None:
     if p.grow_policy not in ("auto", "leafwise", "frontier"):
         raise ValueError(
             f"grow_policy must be auto/leafwise/frontier, got {p.grow_policy}")
+    if p.tree_learner not in ("serial", "data", "feature", "voting"):
+        raise ValueError(
+            "tree_learner must be serial/data/feature/voting, got "
+            f"{p.tree_learner!r}")
+    if p.top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {p.top_k}")
     if p.monotone_constraints is not None:
         if any(c not in (-1, 0, 1) for c in p.monotone_constraints):
             raise ValueError(

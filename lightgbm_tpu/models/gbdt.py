@@ -109,6 +109,29 @@ def resolve_hist_dtype(p: Params, n_rows: int) -> str:
     return "bf16" if n_rows >= (1 << 19) else "f32"
 
 
+def check_int8_row_limit(p: Params, n_rows: int, n_shards: int = 1) -> None:
+    """Fail fast when ``hist_dtype='int8'`` cannot accumulate exactly.
+
+    The kernel-level guard (``hist_fused_pallas``) catches this too, but
+    only at trace time inside the compiled round — by which point the
+    user has paid dataset binning and sharding.  This check runs once per
+    ``update()`` with the Booster's own shard count, so oversized int8
+    configs die with a clear message before any lowering.
+    """
+    if resolve_hist_dtype(p, n_rows) != "int8":
+        return
+    from ..ops.histogram_pallas import INT8_ACC_ROW_LIMIT
+
+    per_shard = -(-n_rows // max(int(n_shards), 1))
+    if per_shard > INT8_ACC_ROW_LIMIT:
+        raise ValueError(
+            f"hist_dtype='int8' with {per_shard:,} rows per device shard "
+            f"(n={n_rows:,} over {n_shards} shard(s)) exceeds the exact "
+            f"int32 accumulation limit of {INT8_ACC_ROW_LIMIT:,} rows — "
+            f"histograms would silently wrap.  Use hist_dtype='bf16' or "
+            f"train on more devices.")
+
+
 def _exact_overgrow_target(num_leaves: int, width: int, over: float) -> int:
     """Wave-aligned overgrowth target for the exact tail.
 
@@ -277,7 +300,8 @@ def _goss_compact_round(bins, y, w, bag, pred, fmask, hyper: HyperScalars,
                         renew_alpha, axis_name=None, sample_key=None,
                         mono=None, extra_trees=False, col_bins=None,
                         renew_scale=None, ic_member=None,
-                        bynode_off=False):
+                        bynode_off=False, hist_merge="psum", n_shards=1,
+                        voting_k=0):
     """One compacted GOSS round (shared by the per-round and scanned paths
     — the two MUST stay in RNG lockstep for fused == host training).
 
@@ -329,7 +353,8 @@ def _goss_compact_round(bins, y, w, bag, pred, fmask, hyper: HyperScalars,
         hist_impl=hist_impl, row_chunk=row_chunk, hist_dtype=hist_dtype,
         wave_width=wave_width, cat_info=cat_info, axis_name=axis_name,
         mono=mono, extra_trees=extra_trees, col_bins=col_bins,
-        ic_member=ic_member, fuse_partition=True)
+        ic_member=ic_member, fuse_partition=True, hist_merge=hist_merge,
+        n_shards=n_shards, voting_k=voting_k)
     if renew_alpha is not None:
         rw = w[idx] * wt
         if renew_scale is not None:
@@ -1024,15 +1049,53 @@ class Booster:
             member.append([1 if i == c else 0 for i in range(len(cols))])
         return tuple(tuple(row) for row in member)
 
+    def _dp_merge_mode(self):
+        """Resolve the row-sharded learners' histogram merge topology.
+
+        Returns static ``(merge_mode, voting_k)`` for the dp step builders:
+        ``tree_learner="data"`` routes to ``reduce_scatter`` (LightGBM's
+        data-parallel Reduce-Scatter — each shard receives its F/D feature
+        slice, 1/D the comm bytes, serial-parity-exact trees) and
+        ``"voting"`` to the PV-Tree voting merge (``top_k`` ballots,
+        approximate) — they are distinct topologies since r9, not aliases
+        of the full psum.  ``params={'histogram_merge': ...}`` overrides
+        the routing (e.g. ``"psum"`` to A/B the r0 baseline, or
+        ``"reduce_scatter_ring"`` for the ppermute ring decomposition
+        whose hops interleave with partition compute).  Voting needs a
+        numeric-threshold ballot, so categorical datasets fall back to
+        reduce-scatter with a warning.
+        """
+        import warnings
+
+        p = self.params
+        override = p.extra.get("histogram_merge")
+        if override is not None:
+            valid = ("psum", "reduce_scatter", "reduce_scatter_ring",
+                     "voting")
+            if override not in valid:
+                raise ValueError(
+                    f"histogram_merge must be one of {valid}, "
+                    f"got {override!r}")
+            mode = override
+        elif p.tree_learner == "voting":
+            mode = "voting"
+        else:
+            mode = "reduce_scatter"
+        if mode == "voting" and self._cat_key is not None:
+            warnings.warn(
+                "tree_learner='voting' does not support categorical "
+                "features (the local ballot scans numeric thresholds "
+                "only); using the reduce_scatter merge instead",
+                stacklevel=3)
+            mode = "reduce_scatter"
+        return mode, int(p.top_k)
+
     def _maybe_setup_dp(self) -> None:
         """Shard the training arrays over the local device mesh when the
-        user asks for a parallel tree learner (LightGBM ``tree_learner=data``
-        — the psum histogram-merge path, SURVEY.md §2C / VERDICT r1 item 6).
-
-        ``feature``/``voting`` learners are distribution *strategies* in
-        upstream LightGBM that produce the same model as ``data``; on TPU
-        the histogram allreduce is a single ``psum`` over ICI, so all three
-        map to row sharding (documented in README).
+        user asks for a row-sharded parallel tree learner (LightGBM
+        ``tree_learner=data`` / ``voting`` — SURVEY.md §2C / VERDICT r1
+        item 6).  The histogram merge topology each learner uses is
+        resolved separately by :meth:`_dp_merge_mode`.
         """
         import warnings
 
@@ -1293,6 +1356,10 @@ class Booster:
                       int(p.other_rate * ds.num_data_))
             if self._num_class == 1:  # mc uses the masked (non-compacted) path
                 eff_rows = goss_k[0] + goss_k[1]
+        _dp_m = getattr(self, "_dp_mesh", None)
+        check_int8_row_limit(
+            p, eff_rows,
+            int(_dp_m.shape["data"]) if _dp_m is not None else 1)
         round_key = jax.random.fold_in(self._key, i)
         if getattr(self, "_fp_mesh", None) is not None:
             from ..parallel.feature_parallel import make_fp_train_step
@@ -1320,12 +1387,14 @@ class Booster:
             stats = jnp.stack(
                 [g * bag, h * bag, (bag > 0).astype(jnp.float32)], axis=-1)
             stats = shard_rows(self._dp_mesh, stats)
+            merge_mode, voting_k = self._dp_merge_mode()
             fn = make_dp_grow_step(
                 self._dp_mesh, p.num_leaves, self._num_bins,
                 p.extra.get("hist_impl", "auto"),
                 int(p.extra.get("row_chunk", 131072)),
                 resolve_wave_width(p, eff_rows),
-                resolve_hist_dtype(p, eff_rows))
+                resolve_hist_dtype(p, eff_rows),
+                merge_mode, voting_k)
             tree, row_leaf = fn(self._dp_bins, stats, fmask, self._hyper,
                                 round_key)
             new_pred = self._pred_train + jnp.float32(p.learning_rate) \
@@ -1334,12 +1403,14 @@ class Booster:
                 self._linear_k is not None:
             from ..parallel.data_parallel import make_dp_linear_train_step
 
+            merge_mode, voting_k = self._dp_merge_mode()
             fn = make_dp_linear_train_step(
                 self._dp_mesh, self._obj_key, p.num_leaves, self._num_bins,
                 p.extra.get("hist_impl", "auto"),
                 int(p.extra.get("row_chunk", 131072)),
                 resolve_hist_dtype(p, eff_rows),
-                resolve_wave_width(p, eff_rows), self._linear_k)
+                resolve_wave_width(p, eff_rows), self._linear_k,
+                merge_mode, voting_k)
             tree, new_pred = fn(self._dp_bins, self._dp_y, self._dp_w,
                                 self._bag, self._pred_train, self._dp_xraw,
                                 fmask, self._hyper, round_key)
@@ -1356,6 +1427,7 @@ class Booster:
                                 max(goss_k[1] // n_dev, 1))
                 if self._num_class == 1:
                     eff_rows = sum(goss_k_shard)
+            merge_mode, voting_k = self._dp_merge_mode()
             fn = make_dp_train_step(
                 self._dp_mesh, self._obj_key, p.num_leaves, self._num_bins,
                 p.extra.get("hist_impl", "auto"),
@@ -1363,7 +1435,8 @@ class Booster:
                 resolve_wave_width(p, eff_rows),
                 resolve_hist_dtype(p, eff_rows), goss_k_shard,
                 self._mono_key, p.extra_trees, self._nbins_key,
-                self._num_class, self._ic_key, self._cat_key)
+                self._num_class, self._ic_key, self._cat_key,
+                merge_mode, voting_k)
             tree, new_pred = fn(self._dp_bins, self._dp_y, self._dp_w,
                                 self._bag, self._pred_train, fmask,
                                 self._hyper, round_key)

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.histogram import compute_histograms, histogram_psum
+from ..ops.histogram import compute_histograms, histogram_merge, histogram_psum
 from ..ops.lookup import lookup_rows, lookup_values
 from ..ops.split import (
     BestSplit,
@@ -266,14 +266,13 @@ def _fp_reduce_best(bs: BestSplit, axis_name: str,
     OWN feature slice; all-gather the per-shard winners, take the global
     argmax, and globalize the winning feature index (upstream
     FeatureParallelTreeLearner's split exchange — one tiny allgather
-    instead of allreducing full histograms)."""
-    shard = lax.axis_index(axis_name)
-    globalized = bs._replace(
-        feature=bs.feature + shard * f_local)
-    stacked = jax.tree.map(
-        lambda x: lax.all_gather(x, axis_name), globalized)  # [D, ...]
-    win = jnp.argmax(stacked.gain)
-    return jax.tree.map(lambda x: x[win], stacked)
+    instead of allreducing full histograms).  Shared with the data-parallel
+    reduce-scatter/voting merge modes — single source lives in
+    parallel.feature_parallel (imported lazily: that module imports
+    models.gbdt at load time)."""
+    from ..parallel.feature_parallel import reduce_best_split
+
+    return reduce_best_split(bs, axis_name, f_local)
 
 
 def _fp_column(bins_local: jnp.ndarray, feat_global, axis_name: str,
@@ -281,11 +280,139 @@ def _fp_column(bins_local: jnp.ndarray, feat_global, axis_name: str,
     """Fetch the GLOBAL feature column under feature sharding: only the
     owning shard has it, so it contributes the codes and a psum broadcasts
     them (the [n] bitmap exchange of upstream's feature-parallel split)."""
-    shard = lax.axis_index(axis_name)
-    local_idx = feat_global - shard * f_local
-    mine = (local_idx >= 0) & (local_idx < f_local)
-    col = jnp.take(bins_local, jnp.clip(local_idx, 0, f_local - 1), axis=1)
-    return lax.psum(jnp.where(mine, col, 0), axis_name)
+    from ..parallel.feature_parallel import broadcast_feature_column
+
+    return broadcast_feature_column(bins_local, feat_global, axis_name,
+                                    f_local)
+
+
+def _make_dist_scorer(axis_name: str, hist_merge: str, n_shards: int,
+                      num_features: int, ctx, cat_info, mono, voting_k: int):
+    """Build the batched split scorer for the distributed histogram-merge
+    modes (``reduce_scatter`` / ``reduce_scatter_ring`` / ``voting``).
+
+    Returns ``score(hist_s, masks, depth_ok_s, lo_s, hi_s, po_s, rand_s)
+    -> BestSplit`` batched over the leading segment axis, with GLOBAL
+    feature ids (the per-shard winners are combined through the same
+    all-gather + argmax exchange the feature-parallel learner uses —
+    :func:`~lightgbm_tpu.parallel.feature_parallel.reduce_best_split`).
+
+    ``hist_s`` is the merged ``[S, F_pad/D, B, 3]`` feature SLICE under
+    reduce-scatter, or the LOCAL unmerged ``[S, F, B, 3]`` partials under
+    voting (the ballot and the candidate-union merge both happen here).
+    All other per-feature arguments stay GLOBAL ``[.., F]`` — the scorer
+    slices them to match, so monotone/categorical/extra-trees/interaction
+    masks need no caller-side changes.  Because every shard holds
+    contiguous ascending feature ranges, the cross-shard argmax preserves
+    the serial scan's first-occurrence tie-break (lowest shard = lowest
+    global feature id), which is what makes reduce-scatter mode
+    serial-parity-exact.
+    """
+    from ..ops.split import feature_best_gains
+    from ..parallel.feature_parallel import reduce_best_split
+
+    rs = hist_merge in ("reduce_scatter", "reduce_scatter_ring")
+    f_pad = -(-num_features // n_shards) * n_shards
+    f_loc = f_pad // n_shards
+
+    def pad_f(a, axis, value):
+        if f_pad == num_features:
+            return a
+        pads = [(0, 0)] * a.ndim
+        pads[axis] = (0, f_pad - num_features)
+        return jnp.pad(a, pads, constant_values=value)
+
+    def fslice(a, axis, value=0):
+        start = lax.axis_index(axis_name) * f_loc
+        return lax.dynamic_slice_in_dim(pad_f(a, axis, value), start, f_loc,
+                                        axis=axis)
+
+    if rs:
+        # static per-feature config arrays slice ONCE; padded tail columns
+        # carry mask 0 / mono 0 / is_cat False so a ragged last shard (or a
+        # fully-padded shard when D > F) scores every pad slot -inf
+        cat_l = (None if cat_info is None else cat_info._replace(
+            is_cat=fslice(cat_info.is_cat, 0, False)))
+        mono_l = None if mono is None else fslice(mono, 0, 0)
+
+        def score(hist_s, masks, depth_ok_s, lo_s, hi_s, po_s, rand_s=None):
+            masks_l = fslice(masks, 1, 0.0)
+            if rand_s is None:
+                def one(h, m, d, lo, hi, po):
+                    return find_best_split(h, ctx, m, d, cat_l, mono_l,
+                                           lo, hi, po)
+
+                bs = jax.vmap(one)(hist_s, masks_l, depth_ok_s, lo_s, hi_s,
+                                   po_s)
+            else:
+                def one(h, m, d, lo, hi, po, rb):
+                    return find_best_split(h, ctx, m, d, cat_l, mono_l,
+                                           lo, hi, po, rb)
+
+                bs = jax.vmap(one)(hist_s, masks_l, depth_ok_s, lo_s, hi_s,
+                                   po_s, fslice(rand_s, 1, 0))
+            return jax.vmap(
+                lambda b: reduce_best_split(b, axis_name, f_loc))(bs)
+
+        return score
+
+    # ---- voting merge (PV-Tree / upstream VotingParallelTreeLearner) ----
+    # Each shard nominates its local top-k features by LOCAL gain; the
+    # global candidate set is the top-(2k) by vote count, and only those
+    # columns are reduce-scattered.  Approximate by construction (a
+    # feature strong globally but nowhere locally top-k is never merged);
+    # when 2k >= F the union is exact and the result matches reduce-scatter
+    # (minus candidate ORDER, so the exact-union short-circuit below keeps
+    # ascending ids for strict parity).
+    k_top = max(1, min(int(voting_k) if voting_k else 20, num_features))
+    kc = min(2 * k_top, num_features)
+    kc_pad = -(-kc // n_shards) * n_shards
+    kc_loc = kc_pad // n_shards
+    exact_union = kc == num_features
+
+    def one_vote(h_local, m, d, lo, hi, po, rb):
+        if exact_union:
+            cand_ids = lax.iota(jnp.int32, kc)
+        else:
+            g_loc = feature_best_gains(h_local, ctx, m, d, mono=mono,
+                                       bound_lo=lo, bound_hi=hi,
+                                       parent_out=po, rand_bins=rb)
+            kth = -jnp.sort(-g_loc)[k_top - 1]
+            local_top = jnp.isfinite(g_loc) & (g_loc >= kth)
+            votes = lax.psum(local_top.astype(jnp.float32), axis_name)
+            # stable argsort of -votes: vote ties resolve to the lower
+            # feature id on every shard identically
+            cand_ids = jnp.argsort(-votes, stable=True)[:kc].astype(
+                jnp.int32)
+        cand_hist = jnp.take(h_local, cand_ids, axis=0)       # [kc, B, 3]
+        if kc_pad != kc:
+            cand_hist = jnp.pad(cand_hist,
+                                ((0, kc_pad - kc), (0, 0), (0, 0)))
+            cand_ids = jnp.pad(cand_ids, (0, kc_pad - kc))
+        merged = lax.psum_scatter(cand_hist, axis_name,
+                                  scatter_dimension=0, tiled=True)
+        shard = lax.axis_index(axis_name)
+        ids_l = lax.dynamic_slice_in_dim(cand_ids, shard * kc_loc, kc_loc)
+        slot = shard * kc_loc + lax.iota(jnp.int32, kc_loc)
+        valid = slot < kc               # pad slots: zero hist, masked out
+        m_l = jnp.where(valid, m[ids_l], 0.0)
+        mono_l2 = None if mono is None else jnp.where(valid, mono[ids_l], 0)
+        rb_l = None if rb is None else rb[ids_l]
+        bs = find_best_split(merged, ctx, m_l, d, None, mono_l2, lo, hi,
+                             po, rb_l)
+        return reduce_best_split(bs, axis_name, kc_loc, feature_map=ids_l)
+
+    def score(hist_s, masks, depth_ok_s, lo_s, hi_s, po_s, rand_s=None):
+        if rand_s is None:
+            def onev(h, m, d, lo, hi, po):
+                return one_vote(h, m, d, lo, hi, po, None)
+
+            return jax.vmap(onev)(hist_s, masks, depth_ok_s, lo_s, hi_s,
+                                  po_s)
+        return jax.vmap(one_vote)(hist_s, masks, depth_ok_s, lo_s, hi_s,
+                                  po_s, rand_s)
+
+    return score
 
 
 def renew_leaf_values(tree: Tree, row_leaf: jnp.ndarray, residual: jnp.ndarray,
@@ -397,6 +524,9 @@ def grow_tree(
     wave_tail: str = "half",
     fuse_partition: bool = False,
     fuse_split: bool = True,
+    hist_merge: str = "psum",
+    n_shards: int = 1,
+    voting_k: int = 0,
 ) -> Tuple[Tree, jnp.ndarray]:
     """Grow one best-first tree.
 
@@ -433,6 +563,17 @@ def grow_tree(
         the plain numeric path (no categorical/monotone/extra-trees/
         interaction/bynode-sampling/feature-parallel); numerics are
         bitwise identical (tests/test_split_iter_fused.py).
+      hist_merge: how per-shard histogram partials combine under
+        ``axis_name`` (see :func:`~lightgbm_tpu.ops.histogram.
+        histogram_merge`): ``"psum"`` (full allreduce, the r0 baseline),
+        ``"reduce_scatter"`` / ``"reduce_scatter_ring"`` (each shard
+        receives only its ``F/D`` feature slice and scans splits over it
+        — LightGBM's data-parallel Reduce-Scatter topology, 1/D the comm
+        bytes, serial-parity-exact), or ``"voting"`` (PV-Tree: shards
+        nominate local top-k features, only the voted candidate union is
+        merged — approximate, cheapest).  ``n_shards`` must give the
+        static mesh-axis size for the non-psum modes; ``voting_k`` is
+        the per-shard ballot size (top-2k candidates merge globally).
 
     Returns:
       (Tree, row_leaf) — row_leaf gives each training row's final leaf node id
@@ -482,7 +623,8 @@ def grow_tree(
             cat_info=cat_info, mono=mono, extra_trees=extra_trees,
             col_bins=col_bins, ic_member=ic_member, wave_tail=wave_tail,
             overgrow_leaves=overgrow_leaves, fp_axis=fp_axis,
-            fuse_partition=fuse_partition)
+            fuse_partition=fuse_partition, hist_merge=hist_merge,
+            n_shards=n_shards, voting_k=voting_k)
     n, num_features = bins.shape
     capacity = 2 * num_leaves - 1
     max_depth = jnp.asarray(max_depth, jnp.int32)
@@ -490,6 +632,24 @@ def grow_tree(
     if key is None:
         key = jax.random.PRNGKey(0)
     bynode_off = ff_bynode is None   # static: skip the per-node RNG draw
+
+    if axis_name is None:
+        hist_merge = "psum"          # single-shard: nothing to merge
+    dist_mode = hist_merge != "psum"
+    if dist_mode and fp_axis is not None:
+        raise ValueError(
+            f"hist_merge={hist_merge!r} is a data-parallel merge topology "
+            "and cannot compose with feature sharding (fp_axis) — the 2-D "
+            "dp x fp mesh keeps the psum merge")
+    if hist_merge == "voting" and cat_info is not None:
+        raise ValueError(
+            "hist_merge='voting' does not support categorical splits (the "
+            "local ballot scans numeric thresholds only) — use "
+            "'reduce_scatter' or 'psum'")
+    score_dist = (_make_dist_scorer(axis_name, hist_merge, n_shards,
+                                    num_features, ctx, cat_info, mono,
+                                    voting_k)
+                  if dist_mode else None)
 
     # Split-iteration mega-kernel gate (ops.histogram_pallas
     # ._split_iter_kernel): the ~49-fusion tail of each split iteration —
@@ -504,7 +664,7 @@ def grow_tree(
     # ``fuse_split=False`` keeps the reference XLA body for debugging.
     fuse_si = (fuse_split and cat_info is None and mono is None
                and not extra_trees and ic_member is None and bynode_off
-               and fp_axis is None)
+               and fp_axis is None and not dist_mode)
 
     def node_feature_mask(node_id):
         """Per-node column subsample drawn WITHIN the per-tree subset
@@ -535,11 +695,22 @@ def grow_tree(
         op = batched_histogram_op(num_segments, num_bins, row_chunk,
                                   hist_impl, hist_dtype)
         h = op(bins, stats, seg_id)
-        return histogram_psum(h, axis_name)
+        if hist_merge == "voting":
+            return h       # local partials; the scorer merges candidates
+        return histogram_merge(h, axis_name, mode=hist_merge,
+                               n_shards=n_shards)
 
     # ---- root -------------------------------------------------------------
+    # under rs the merged root_hist is this shard's [F_pad/D, B, 3] slice;
+    # under voting the LOCAL unmerged partial
     root_hist = hist_fn(jnp.zeros(n, jnp.int32), 1)[0]          # [F, B, 3]
-    root_tot = jnp.sum(root_hist[0], axis=0)                     # (g, h, c)
+    if dist_mode:
+        # global totals without the full histogram: stats rows sum to the
+        # histogram totals by construction, so one [3]-element psum
+        # replaces reading bins of feature 0 from a (now sliced) histogram
+        root_tot = lax.psum(jnp.sum(stats, axis=0), axis_name)
+    else:
+        root_tot = jnp.sum(root_hist[0], axis=0)                 # (g, h, c)
     # root output: unsmoothed (no parent), but still max_delta_step-capped
     root_out = constrained_leaf_output(
         root_tot[0], root_tot[1], root_tot[2],
@@ -553,10 +724,18 @@ def grow_tree(
         root_mask = node_feature_mask(0)
     # LightGBM convention: max_depth <= 0 means unlimited, so the root
     # (depth 0) is always splittable — if a limit exists it is >= 1.
-    root_best = find_best_split(root_hist, ctx, root_mask,
-                                jnp.bool_(True), cat_info, mono=mono,
-                                parent_out=root_out,
-                                rand_bins=node_rand_bins(0))
+    if dist_mode:
+        rb0 = node_rand_bins(0)
+        root_best = jax.tree.map(lambda x: x[0], score_dist(
+            root_hist[None], root_mask[None], jnp.ones((1,), bool),
+            jnp.full((1,), -jnp.inf, jnp.float32),
+            jnp.full((1,), jnp.inf, jnp.float32), root_out[None],
+            None if rb0 is None else rb0[None]))
+    else:
+        root_best = find_best_split(root_hist, ctx, root_mask,
+                                    jnp.bool_(True), cat_info, mono=mono,
+                                    parent_out=root_out,
+                                    rand_bins=node_rand_bins(0))
     if fp_axis is not None:
         root_best = _fp_reduce_best(root_best, fp_axis, num_features)
 
@@ -698,7 +877,13 @@ def grow_tree(
         child_lo = jnp.stack([lo_l, lo_r])
         child_hi = jnp.stack([hi_l, hi_r])
         child_out = jnp.stack([wl_v, wr_v])
-        if extra_trees:
+        if dist_mode:
+            child_rand = (jnp.stack([node_rand_bins(nl), node_rand_bins(nr)])
+                          if extra_trees else None)
+            bs = score_dist(hist2, child_masks, jnp.stack([depth_ok,
+                                                           depth_ok]),
+                            child_lo, child_hi, child_out, child_rand)
+        elif extra_trees:
             child_rand = jnp.stack([node_rand_bins(nl), node_rand_bins(nr)])
 
             def score(h, m, lo_, hi_, po, rb):
@@ -944,6 +1129,9 @@ def grow_tree_frontier(
     overgrow_leaves: Optional[int] = None,
     fp_axis: Optional[str] = None,
     fuse_partition: bool = False,
+    hist_merge: str = "psum",
+    n_shards: int = 1,
+    voting_k: int = 0,
 ) -> Tuple[Tree, jnp.ndarray]:
     """Best-first growth in WAVES: up to ``wave_width`` splits per data pass.
 
@@ -1012,6 +1200,33 @@ def grow_tree_frontier(
         key = jax.random.PRNGKey(0)
     bynode_off = ff_bynode is None   # static: skip the per-node RNG draw
 
+    if axis_name is None:
+        hist_merge = "psum"          # single-shard: nothing to merge
+    dist_mode = hist_merge != "psum"
+    if dist_mode and fp_axis is not None:
+        raise ValueError(
+            f"hist_merge={hist_merge!r} is a data-parallel merge topology "
+            "and cannot compose with feature sharding (fp_axis) — the 2-D "
+            "dp x fp mesh keeps the psum merge")
+    if hist_merge == "voting" and cat_info is not None:
+        raise ValueError(
+            "hist_merge='voting' does not support categorical splits (the "
+            "local ballot scans numeric thresholds only) — use "
+            "'reduce_scatter' or 'psum'")
+    score_dist = (_make_dist_scorer(axis_name, hist_merge, n_shards,
+                                    num_features, ctx, cat_info, mono,
+                                    voting_k)
+                  if dist_mode else None)
+    # per-leaf histogram cache feature extent: the merged SLICE under
+    # reduce-scatter (a D-fold cache memory drop — the subtraction trick is
+    # linear, so parent - child on slices is the slice of the subtraction);
+    # under voting the cache keeps LOCAL unmerged partials (additive too —
+    # the candidate-union merge happens at scoring time)
+    if dist_mode and hist_merge != "voting":
+        f_hist = (-(-num_features // n_shards) * n_shards) // n_shards
+    else:
+        f_hist = num_features
+
     def node_feature_mask(node_id):
         if bynode_off:
             return feature_mask
@@ -1033,11 +1248,20 @@ def grow_tree_frontier(
         op = batched_histogram_op(num_segments, num_bins, row_chunk,
                                   hist_impl, hist_dtype)
         h = op(bins, stats, seg_id)
-        return histogram_psum(h, axis_name)
+        if hist_merge == "voting":
+            return h       # local partials; the scorer merges candidates
+        return histogram_merge(h, axis_name, mode=hist_merge,
+                               n_shards=n_shards)
 
     # ---- root -------------------------------------------------------------
-    root_hist = hist_fn(jnp.zeros(n, jnp.int32), 1)[0]          # [F, B, 3]
-    root_tot = jnp.sum(root_hist[0], axis=0)                     # (g, h, c)
+    root_hist = hist_fn(jnp.zeros(n, jnp.int32), 1)[0]      # [f_hist, B, 3]
+    if dist_mode:
+        # global totals from the stats rows (they sum to the histogram
+        # totals by construction) — one [3]-element psum instead of
+        # reading feature 0's bins from a sliced/unmerged histogram
+        root_tot = lax.psum(jnp.sum(stats, axis=0), axis_name)
+    else:
+        root_tot = jnp.sum(root_hist[0], axis=0)                 # (g, h, c)
     root_out = constrained_leaf_output(
         root_tot[0], root_tot[1], root_tot[2],
         ctx._replace(path_smooth=jnp.float32(0.0)),
@@ -1048,10 +1272,18 @@ def grow_tree_frontier(
                                      ic_member))
     else:
         root_mask_f = node_feature_mask(0)
-    root_best = find_best_split(root_hist, ctx, root_mask_f,
-                                jnp.bool_(True), cat_info, mono=mono,
-                                parent_out=root_out,
-                                rand_bins=node_rand_bins(0))
+    if dist_mode:
+        rb0 = node_rand_bins(0)
+        root_best = jax.tree.map(lambda x: x[0], score_dist(
+            root_hist[None], root_mask_f[None], jnp.ones((1,), bool),
+            jnp.full((1,), -jnp.inf, jnp.float32),
+            jnp.full((1,), jnp.inf, jnp.float32), root_out[None],
+            None if rb0 is None else rb0[None]))
+    else:
+        root_best = find_best_split(root_hist, ctx, root_mask_f,
+                                    jnp.bool_(True), cat_info, mono=mono,
+                                    parent_out=root_out,
+                                    rand_bins=node_rand_bins(0))
     if fp_axis is not None:
         # feature-parallel: each shard scanned its own column slice; one
         # tiny all_gather + argmax globalizes the winner (the same split
@@ -1066,7 +1298,7 @@ def grow_tree_frontier(
     st = _WaveState(
         nodes=_packed_root_table(capacity, root_out, root_tot, root_best,
                                  cat_info),
-        hist_cache=jnp.zeros((grow_leaves, num_features, num_bins, 3),
+        hist_cache=jnp.zeros((grow_leaves, f_hist, num_bins, 3),
                              jnp.float32).at[0].set(root_hist),
         node_slot=full(0, jnp.int32),
         row_leaf=jnp.zeros(n, jnp.int32),
@@ -1190,7 +1422,13 @@ def grow_tree_frontier(
                 # code rows; ignored on single-block shapes
                 wfeat=prow[:, K.CAND_FEAT].astype(jnp.int32),
                 num_features=num_features)
-            direct_hist = histogram_psum(direct_hist, axis_name)
+            # the kernel's direct_hist is the LOCAL pre-merge [W, F, B, 3]
+            # partial, so every merge topology applies after it unchanged
+            # (voting keeps it unmerged for the scorer's candidate union)
+            if hist_merge != "voting":
+                direct_hist = histogram_merge(direct_hist, axis_name,
+                                              mode=hist_merge,
+                                              n_shards=n_shards)
             enc = enc[:n]
             row_leaf = jnp.where(enc > 0, st.n_nodes + enc - 1, p)
         else:
@@ -1273,7 +1511,7 @@ def grow_tree_frontier(
         # and commits a pure += the while-carry can alias in place.
         # Exactness: one-hot factors are exact at every precision and
         # HIGHEST keeps the f32 cache values bit-exact.
-        fb3 = num_features * num_bins * 3
+        fb3 = f_hist * num_bins * 3
         cache_flat = st.hist_cache.reshape(grow_leaves, fb3)
         parent_slot = st.node_slot[parent_r]              # [W]
         oh_p = (parent_slot[:, None]
@@ -1283,7 +1521,7 @@ def grow_tree_frontier(
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=lax.Precision.HIGHEST,
-        ).reshape(w_width, num_features, num_bins, 3)
+        ).reshape(w_width, f_hist, num_bins, 3)
         other_hist = parent_hist - direct_hist
         dl = direct_left[:, None, None, None]
         left_hist = jnp.where(dl, direct_hist, other_hist)
@@ -1335,7 +1573,12 @@ def grow_tree_frontier(
         child_lo = jnp.concatenate([lo_l, lo_r])
         child_hi = jnp.concatenate([hi_l, hi_r])
         child_vals = jnp.concatenate([wl_w, wr_w])        # actual outputs
-        if extra_trees:
+        if dist_mode:
+            child_rand = (jax.vmap(node_rand_bins)(child_nodes)
+                          if extra_trees else None)
+            bs = score_dist(child_hists, child_masks, depth_ok, child_lo,
+                            child_hi, child_vals, child_rand)
+        elif extra_trees:
             child_rand = jax.vmap(node_rand_bins)(child_nodes)
 
             def score(h, m, d, lo_, hi_, po, rb):

@@ -1,6 +1,6 @@
 """Core TPU compute ops: histogram construction, split search, traversal."""
 
-from .histogram import compute_histograms, histogram_psum
+from .histogram import compute_histograms, histogram_merge, histogram_psum
 from .split import (
     BestSplit,
     SplitContext,
@@ -13,6 +13,7 @@ from .predict import predict_forest_binned, predict_tree_binned
 
 __all__ = [
     "compute_histograms",
+    "histogram_merge",
     "histogram_psum",
     "BestSplit",
     "SplitContext",

@@ -240,8 +240,14 @@ def compute_histograms_batched(
                                          num_bins, hist_dtype=hist_dtype)
     segstats = _segstats(stats, seg_id, num_segments)      # [E, n, K*S]
     segstats = jnp.moveaxis(segstats, 0, 1).reshape(n, k_inner)
-    if impl == "pallas" or (impl == "auto" and not exact and k_inner >= 64
-                            and jax.default_backend() == "tpu"):
+    # int8 never enters the segstats kernel: it has no quantization path
+    # (and raises since r9 — before that it silently ran full precision).
+    # The XLA fallback below runs int8 at full precision by documented
+    # design, keeping hist_impl="jnp"/CPU usable.
+    if hist_dtype != "int8" and (
+            impl == "pallas" or (impl == "auto" and not exact
+                                 and k_inner >= 64
+                                 and jax.default_backend() == "tpu")):
         from .histogram_pallas import hist_from_segstats_pallas
         hists = hist_from_segstats_pallas(bins, segstats, num_bins,
                                           hist_dtype=hist_dtype)
@@ -305,7 +311,98 @@ def histogram_psum(hist: jnp.ndarray, axis_name: Optional[str]) -> jnp.ndarray:
     socket/MPI/NCCL allreduce (upstream ``network/``; SURVEY.md §5
     "Distributed communication backend").  Inside ``shard_map`` over a row-
     sharded mesh axis, per-shard partial histograms are summed over ICI/DCN.
+
+    Thin compatibility wrapper over :func:`histogram_merge` with
+    ``mode="psum"`` — the full-allreduce topology every shard replicates.
+    """
+    return histogram_merge(hist, axis_name, mode="psum")
+
+
+def pad_feature_axis(hist: jnp.ndarray, n_shards: int,
+                     axis: int) -> jnp.ndarray:
+    """Zero-pad the feature axis to a multiple of ``n_shards`` (padded
+    columns are all-zero histograms, masked out of every split scan by the
+    sliced feature mask — same idiom as feature_parallel.pad_features)."""
+    f = hist.shape[axis]
+    f_pad = -(-f // n_shards) * n_shards
+    if f_pad == f:
+        return hist
+    pads = [(0, 0)] * hist.ndim
+    pads[axis] = (0, f_pad - f)
+    return jnp.pad(hist, pads)
+
+
+def ring_reduce_scatter(x: jnp.ndarray, axis_name: str, n_shards: int,
+                        axis: int) -> jnp.ndarray:
+    """Reduce-scatter decomposed into ``n_shards - 1`` ``ppermute`` hops.
+
+    Chunk ``c``'s partial starts at shard ``c+1`` and travels the ring
+    ``c+1 -> c+2 -> ... -> c``, each hop adding the receiver's local
+    contribution, so shard ``i`` ends holding chunk ``i`` summed over all
+    shards.  Semantically identical to ``lax.psum_scatter`` but each hop
+    is an independent small collective the latency-hiding scheduler can
+    overlap with whatever compute is pending between issue and first use
+    (the frontier grower's cache gather / partition bookkeeping) — the
+    "ppermute-friendly scheduling" half of the comm/compute overlap.
+    Summation order is fixed (ring order) but differs from psum's
+    reduction tree, so cross-mode results agree to f32 rounding, not
+    bitwise.
+    """
+    f_pad = x.shape[axis]
+    assert f_pad % n_shards == 0, "pad the feature axis first"
+    f_loc = f_pad // n_shards
+    idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    def chunk(k):
+        start = jnp.mod(idx - 1 - k, n_shards) * f_loc
+        return lax.dynamic_slice_in_dim(x, start, f_loc, axis=axis)
+
+    acc = chunk(0)
+    for k in range(1, n_shards):
+        acc = lax.ppermute(acc, axis_name, perm) + chunk(k)
+    return acc
+
+
+def histogram_merge(hist: jnp.ndarray, axis_name: Optional[str],
+                    mode: str = "psum", n_shards: int = 1) -> jnp.ndarray:
+    """Merge per-shard partial histograms ``[..., F, B, C]`` over a mesh axis.
+
+    The topology choice — LightGBM's data-parallel learner evolution
+    expressed as shard_map collectives (upstream ``DataParallelTreeLearner``
+    replaced its naive allreduce with Reduce-Scatter for exactly this
+    reason; arXiv:1706.08359 §distributed, arXiv:1806.11248):
+
+      * ``"psum"`` — full allreduce; every shard materializes the whole
+        merged histogram and re-runs split finding redundantly.  Per-shard
+        received payload: the full ``S*F*B*C`` tensor.
+      * ``"reduce_scatter"`` — one ``lax.psum_scatter`` over the feature
+        axis; each shard receives only its ``F/D`` feature slice (padded to
+        a shard multiple) and scans splits for those features only.
+        Per-shard received payload drops by ``D``; the per-shard winners
+        are then combined with an O(D) all-gather + argmax
+        (parallel.feature_parallel.reduce_best_split).
+      * ``"reduce_scatter_ring"`` — same result via an explicit
+        :func:`ring_reduce_scatter` (D-1 ppermute hops the scheduler can
+        interleave with independent compute).
+
+    The feature axis is ``ndim - 3`` (histograms are ``[..., F, B, C]``).
+    Reduce-scatter modes return the LOCAL padded slice ``[..., F_pad/D, B,
+    C]``; callers must slice per-feature metadata (masks, monotone signs,
+    categorical flags) to the same window and globalize winning feature
+    ids by ``shard * f_local``.
     """
     if axis_name is None:
         return hist
-    return lax.psum(hist, axis_name)
+    if mode == "psum":
+        return lax.psum(hist, axis_name)
+    axis = hist.ndim - 3
+    padded = pad_feature_axis(hist, n_shards, axis)
+    if mode == "reduce_scatter":
+        return lax.psum_scatter(padded, axis_name, scatter_dimension=axis,
+                                tiled=True)
+    if mode == "reduce_scatter_ring":
+        return ring_reduce_scatter(padded, axis_name, n_shards, axis)
+    raise ValueError(
+        f"unknown histogram merge mode {mode!r}; expected 'psum', "
+        "'reduce_scatter', or 'reduce_scatter_ring'")

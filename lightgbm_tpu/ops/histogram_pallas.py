@@ -30,6 +30,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_CHUNK = 2048
 
+# int8 histogram mode quantizes stats to [-127, 127] and accumulates in
+# int32: a (segment, bin) cell holding more than 2^31/127 rows of the
+# channel-max value wraps SILENTLY.  Total rows per shard bounds any
+# cell's count, so callers guard n against this limit (exact, not the
+# old conservative 16M figure).
+INT8_ACC_ROW_LIMIT = (1 << 31) // 127          # 16,909,320
+
 
 def _hist_kernel(bins_ref, segstats_ref, out_ref, *, num_features: int,
                  num_bins: int, hist_dtype: str = "f32"):
@@ -74,6 +81,15 @@ def hist_from_segstats_pallas(
     The [F, B, K] accumulator stays resident in VMEM across row chunks; the
     chunk size adapts to K so accumulator + tiles fit the ~16 MB budget.
     """
+    if hist_dtype == "int8":
+        # this kernel has no quantization path (scales live in
+        # hist_fused_pallas); before r9 it silently ran full precision,
+        # which masked the caller's intent — refuse instead and let
+        # compute_histograms_batched route int8 to the XLA segstats path
+        raise ValueError(
+            "hist_from_segstats_pallas does not implement hist_dtype="
+            "'int8'; use hist_fused_pallas (quantized) or the XLA "
+            "segstats path (full precision).")
     n, num_features = bins.shape
     k = segstats.shape[1]
     if chunk is None:
@@ -302,14 +318,17 @@ def hist_fused_pallas(
         from .histogram import sr_round_bf16   # — measured ~3e-4 WORSE than
         hist_dtype = "bf16"                    # round-to-nearest on Higgs;
         stats = sr_round_bf16(stats)           # kept for other workloads)
-    if hist_dtype == "int8" and n > 16_000_000:
-        # int32 accumulation wraps past 2^31/127 ~= 16.9M rows landing in
+    if hist_dtype == "int8" and n > INT8_ACC_ROW_LIMIT:
+        # int32 accumulation wraps once 2^31/127 = 16,909,320 rows land in
         # one (segment, bin) cell — beyond that, corrupt histograms would
-        # be silent (ADVICE r3).  Shard rows (dp mesh) or use bf16.
+        # be silent (ADVICE r3).  n rows total bounds any single cell's
+        # count, so n <= limit is a proof of no overflow; past it we
+        # refuse rather than wrap.  Shard rows (dp mesh) or use bf16.
         raise ValueError(
-            f"hist_dtype='int8' is limited to 16M rows per device shard "
-            f"(got n={n}): the int32 bin accumulator can overflow. "
-            f"Use hist_dtype='bf16' or shard rows across devices.")
+            f"hist_dtype='int8' is limited to {INT8_ACC_ROW_LIMIT:,} rows "
+            f"per device shard (got n={n:,}): quantized values reach "
+            f"|q|=127 and the int32 bin accumulator wraps past 2^31/127. "
+            f"Use hist_dtype='bf16' or shard rows across more devices.")
     f_blk, n_fblk, f_pad, auto_chunk = _vmem_blocking(
         num_features, num_bins, k, chunk_align=512)
     if chunk is None:

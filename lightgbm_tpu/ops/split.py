@@ -147,6 +147,52 @@ class CatInfo(NamedTuple):
     max_cat_threshold: int     # static
 
 
+def feature_best_gains(
+    hist: jnp.ndarray,
+    ctx: SplitContext,
+    feature_mask: jnp.ndarray,
+    depth_ok: jnp.ndarray,
+    mono=None,
+    bound_lo=None,
+    bound_hi=None,
+    parent_out=None,
+    rand_bins=None,
+) -> jnp.ndarray:
+    """Per-feature best NUMERIC split gain ``[F]`` over one histogram.
+
+    The voting-parallel learner's ballot (upstream
+    ``VotingParallelTreeLearner`` / PV-Tree): each shard scores its LOCAL
+    partial histogram with this scan and nominates its top-k features;
+    only the nominated union's columns get a histogram merge.  Same
+    numeric core as :func:`find_best_split` (``split_gain_scan`` /
+    ``split_stats_valid``), reduced over the bin axis instead of
+    globally argmax'd; invalid candidates score ``-inf``.
+    """
+    cum = jnp.cumsum(hist, axis=1)
+    total = cum[:, -1:, :]
+    lg, lh, lc = cum[..., 0], cum[..., 1], cum[..., 2]
+    tg, th = total[..., 0], total[..., 1]
+    tc = total[..., 2]
+    rg, rh, rc = tg - lg, th - lh, tc - lc
+    lo = jnp.float32(-jnp.inf) if bound_lo is None else bound_lo
+    hi = jnp.float32(jnp.inf) if bound_hi is None else bound_hi
+    p_out = (leaf_output(tg, th, ctx) if parent_out is None else parent_out)
+    gain, wl, wr = split_gain_scan(lg, lh, lc, rg, rh, rc, tg, th, ctx,
+                                   lo, hi, p_out)
+    valid = (
+        split_stats_valid(lc, rc, lh, rh, gain, ctx)
+        & (feature_mask[:, None] > 0)
+        & depth_ok
+    )
+    if mono is not None:
+        m = mono[:, None].astype(wl.dtype)
+        valid &= (m == 0) | (m * (wr - wl) >= 0)
+    if rand_bins is not None:
+        pos_b = jnp.arange(hist.shape[1])[None, :]
+        valid &= pos_b == rand_bins[:, None]
+    return jnp.max(jnp.where(valid, gain, NEG_INF), axis=1)
+
+
 class BestSplit(NamedTuple):
     gain: jnp.ndarray      # f32 [] best gain (NEG_INF if no valid split)
     feature: jnp.ndarray   # i32 []

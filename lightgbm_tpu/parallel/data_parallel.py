@@ -7,17 +7,26 @@ CUDA/NCCL path its "GPU support" refers to — SURVEY.md §2C, §5):
   * rows are sharded over a 1-D ``Mesh(('data',))`` (ICI within a slice,
     DCN across slices — same mesh abstraction either way);
   * each shard builds histograms for its rows only;
-  * ``jax.lax.psum`` over the ``data`` axis merges them (this IS the
-    allreduce — no sockets, no NCCL, no serialization code);
-  * split decisions are computed redundantly-but-identically on every shard
-    from the merged histograms, so the grown tree is replicated by
-    construction and no broadcast step is needed.
+  * per-shard partials combine through ``ops.histogram.histogram_merge``
+    (``merge_mode``): the r0 baseline is one full ``psum`` (split finding
+    then redundant-but-identical per shard), while ``reduce_scatter``
+    delivers each shard only its ``F/D`` feature slice — split finding is
+    scanned over the slice and the per-shard ``BestSplit`` winners combine
+    with a tiny O(D) all-gather + argmax (upstream's Reduce-Scatter
+    data-parallel learner; 1/D the comm bytes, serial-parity-exact trees);
+  * ``merge_mode="voting"`` adds the PV-Tree voting-parallel topology:
+    shards nominate local top-k features and only the voted candidate
+    union's columns are merged (approximate, cheapest — ``tree_learner=
+    voting``);
+  * either way the grown tree is replicated by construction and no
+    broadcast step is needed.
 
 Scaling note (SURVEY.md §5 "long-context"): a GBDT has no sequence axis; the
-scale axis is rows (this module) and features/bins.  Upstream's
-``feature``/``voting`` learners are alternative distribution strategies for
-the same histogram allreduce; on TPU that allreduce is a single ``psum`` over
-ICI, so all ``tree_learner`` values route here (see README).
+scale axis is rows (this module) and features/bins.  Upstream's ``feature``
+learner distributes columns instead (see ``feature_parallel``); ``data`` and
+``voting`` route HERE with distinct merge topologies since r9 (they
+previously all aliased the same full ``psum`` — see README and
+``analysis.budgets`` for the per-round comm-bytes model).
 """
 
 from __future__ import annotations
@@ -83,24 +92,31 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                        wave_width: int = 1, hist_dtype: str = "f32",
                        goss_k_shard=None, mono_key=None,
                        extra_trees: bool = False, nbins_key=None,
-                       num_class: int = 1, ic_key=None, cat_key=None):
+                       num_class: int = 1, ic_key=None, cat_key=None,
+                       merge_mode: str = "psum", voting_k: int = 0):
     """Build the jitted data-parallel round step for a mesh.
 
     Returns step(bins, y, w, bag, pred, feature_mask, hyper) ->
     (tree [replicated], new_pred [row-sharded]).
 
     The entire per-round body — gradients, bagged stats, the full best-first
-    growth loop with psum-merged histograms, and the train-score update —
-    runs inside ONE ``shard_map``-ed program per round.
+    growth loop with merged histograms, and the train-score update — runs
+    inside ONE ``shard_map``-ed program per round.
 
     ``goss_k_shard``: static PER-SHARD (k_top, k_other) enabling GOSS —
     each shard compacts its own rows (matching upstream's data-parallel
     GOSS, which samples per machine) and the compacted shards' histograms
-    psum-merge as usual.
+    merge as usual.
+
+    ``merge_mode``: histogram merge topology — ``"psum"`` |
+    ``"reduce_scatter"`` | ``"reduce_scatter_ring"`` | ``"voting"``
+    (``voting_k`` = per-shard ballot size); see the module docstring and
+    ``models.tree.grow_tree(hist_merge=...)``.
     """
     from ..models.gbdt import _build_cat_info
 
     obj = _rebuild_objective(obj_key)
+    n_shards = mesh.shape[DATA_AXIS]
     mono_arr = (None if mono_key is None
                 else jnp.asarray(mono_key, jnp.int32))
     colb = (None if nbins_key is None
@@ -141,7 +157,9 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                 row_chunk=row_chunk, hist_dtype=hist_dtype,
                 wave_width=wave_width, mono=mono_arr,
                 extra_trees=extra_trees, col_bins=colb,
-                ic_member=ic_member, cat_info=make_cat(bins.shape[1]))
+                ic_member=ic_member, cat_info=make_cat(bins.shape[1]),
+                hist_merge=merge_mode, n_shards=n_shards,
+                voting_k=voting_k)
 
         from ..models.gbdt import mc_round_update
         return mc_round_update(grow_one, g, h,
@@ -167,7 +185,8 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                 make_cat(bins.shape[1]), None,
                 axis_name=DATA_AXIS, sample_key=sample_key,
                 mono=mono_arr, extra_trees=extra_trees, col_bins=colb,
-                ic_member=ic_member)
+                ic_member=ic_member, hist_merge=merge_mode,
+                n_shards=n_shards, voting_k=voting_k)
             return tree, new_pred
         stats = jnp.stack([g * bag, h * bag, bag], axis=-1)
         tree, row_leaf = grow_tree(
@@ -177,7 +196,8 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
             row_chunk=row_chunk, hist_dtype=hist_dtype,
             wave_width=wave_width, mono=mono_arr, extra_trees=extra_trees,
             col_bins=colb, ic_member=ic_member,
-            cat_info=make_cat(bins.shape[1]), fuse_partition=True)
+            cat_info=make_cat(bins.shape[1]), fuse_partition=True,
+            hist_merge=merge_mode, n_shards=n_shards, voting_k=voting_k)
         shrink = jnp.where(is_rf, 1.0, hyper.learning_rate)
         new_pred = pred + shrink * lookup_values(row_leaf, tree.leaf_value)
         return tree, new_pred
@@ -205,7 +225,8 @@ def make_dp_linear_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                               num_bins: int, hist_impl: str = "auto",
                               row_chunk: int = 131072,
                               hist_dtype: str = "f32",
-                              wave_width: int = 1, linear_k: int = 8):
+                              wave_width: int = 1, linear_k: int = 8,
+                              merge_mode: str = "psum", voting_k: int = 0):
     """Data-parallel ``linear_tree`` round (r5 breadth): constant-leaf
     growth shards rows with psum-merged histograms as usual, then every
     leaf's ridge system accumulates per shard and merges with ONE psum of
@@ -230,7 +251,9 @@ def make_dp_linear_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
             hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
             key=key, axis_name=DATA_AXIS, hist_impl=hist_impl,
             row_chunk=row_chunk, hist_dtype=hist_dtype,
-            wave_width=wave_width, fuse_partition=True)
+            wave_width=wave_width, fuse_partition=True,
+            hist_merge=merge_mode, n_shards=mesh.shape[DATA_AXIS],
+            voting_k=voting_k)
         tree, delta = fit_linear_leaves(
             tree, row_leaf, xraw, g, h, bag, hyper.linear_lambda,
             linear_k, row_chunk, axis_name=DATA_AXIS)
@@ -251,7 +274,8 @@ def make_dp_linear_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
 @functools.lru_cache(maxsize=None)
 def make_dp_grow_step(mesh: Mesh, num_leaves: int, num_bins: int,
                       hist_impl: str = "auto", row_chunk: int = 131072,
-                      wave_width: int = 1, hist_dtype: str = "f32"):
+                      wave_width: int = 1, hist_dtype: str = "f32",
+                      merge_mode: str = "psum", voting_k: int = 0):
     """Data-parallel growth from PRECOMPUTED per-row stats.
 
     The ranking path: LambdaRank gradients need whole queries (the [Q, G]
@@ -272,7 +296,9 @@ def make_dp_grow_step(mesh: Mesh, num_leaves: int, num_bins: int,
             hyper.max_depth, ff_bynode=hyper.feature_fraction_bynode,
             key=key, axis_name=DATA_AXIS, hist_impl=hist_impl,
             row_chunk=row_chunk, hist_dtype=hist_dtype,
-            wave_width=wave_width, fuse_partition=True)
+            wave_width=wave_width, fuse_partition=True,
+            hist_merge=merge_mode, n_shards=mesh.shape[DATA_AXIS],
+            voting_k=voting_k)
         return tree, row_leaf
 
     sharded = shard_map(

@@ -40,6 +40,60 @@ from ..models.tree import grow_tree
 FEATURE_AXIS = "feature"
 
 
+# ---------------------------------------------------------------------------
+# Shared BestSplit reduction helpers.
+#
+# Both distributed split-finding topologies end the same way: every shard
+# holds the best split over SOME feature slice (feature-parallel: its owned
+# column shard; data-parallel reduce-scatter/voting: the slice the histogram
+# merge delivered) and the winners combine with one tiny O(D) all-gather +
+# argmax — upstream's split exchange (``SyncUpGlobalBestSplit``), a few
+# dozen scalars per shard instead of re-allreducing histograms.
+# ---------------------------------------------------------------------------
+
+
+def reduce_best_split(bs, axis_name: str, f_local: int, feature_map=None):
+    """Combine per-shard ``BestSplit`` candidates into the global winner.
+
+    ``bs.feature`` is LOCAL to this shard's feature slice.  With contiguous
+    slices (feature-parallel sharding, reduce-scatter merge) the global id
+    is ``feature + shard * f_local``; a voting merge scans a gathered
+    candidate subset instead and passes ``feature_map`` (i32 ``[f_local]``,
+    local slot -> global feature id).  All-gathering AFTER globalization
+    keeps the combine one argmax over ``[D]`` gains; ties resolve to the
+    lowest shard, which under contiguous ascending slices reproduces the
+    serial scan's first-occurrence tie-break exactly.
+    """
+    from jax import lax
+
+    shard = lax.axis_index(axis_name)
+    if feature_map is None:
+        gfeat = bs.feature + shard * f_local
+    else:
+        gfeat = feature_map[bs.feature]
+    globalized = bs._replace(feature=gfeat)
+    stacked = jax.tree.map(
+        lambda x: lax.all_gather(x, axis_name), globalized)  # [D, ...]
+    win = jnp.argmax(stacked.gain)
+    return jax.tree.map(lambda x: x[win], stacked)
+
+
+def broadcast_feature_column(bins_local, feat_global, axis_name: str,
+                             f_local: int):
+    """Fetch the GLOBAL feature column under feature sharding: only the
+    owning shard has it, so it contributes the codes and a psum broadcasts
+    them (the [n] bitmap exchange of upstream's feature-parallel split).
+    Data-parallel shards hold every column locally and never need this.
+    """
+    from jax import lax
+
+    shard = lax.axis_index(axis_name)
+    local_idx = feat_global - shard * f_local
+    mine = (local_idx >= 0) & (local_idx < f_local)
+    col = jnp.take(bins_local, jnp.clip(local_idx, 0, f_local - 1), axis=1)
+    return lax.psum(jnp.where(mine, col, 0), axis_name)
+
+
 def make_feature_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     """1-D feature-sharding mesh (same device fallback logic as
     data_parallel.make_mesh)."""

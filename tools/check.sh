@@ -28,11 +28,17 @@ else
   echo "== ruff == (not installed; skipping style layer)"
 fi
 
-# 2. graftlint: AST rules + baseline + VMEM estimates
+# 2. graftlint: AST rules + baseline + VMEM estimates + comm budgets
 echo "== graftlint =="
 JAX_PLATFORMS=cpu python -m lightgbm_tpu lint
 
-# 3. trace-level budgets (slow lane)
+# 3. r9 merge-mode serial parity on the virtual 8-device mesh (fast
+#    subset — the same scenarios tier-1 sees in tests/test_merge_modes.py)
+echo "== merge-mode parity (virtual 8-device mesh) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_merge_modes.py -q \
+  -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# 4. trace-level budgets (slow lane)
 if [ "$full" = 1 ]; then
   echo "== budgets + recompile sweeps =="
   JAX_PLATFORMS=cpu python -m lightgbm_tpu lint --budgets -q
