@@ -95,10 +95,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         failed |= any(not r["ok"] for r in res)
 
     # pure arithmetic — always on, like the VMEM estimates
-    from .budgets import check_comm_budgets
+    from .budgets import check_comm_budgets, check_comm_time_budgets
 
     res = check_comm_budgets()
     sections["comm_budgets"] = res
+    failed |= any(not r["ok"] for r in res)
+
+    res = check_comm_time_budgets()
+    sections["comm_time"] = res
     failed |= any(not r["ok"] for r in res)
 
     if budgets:
@@ -122,7 +126,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not quiet:
         for line in l1["stale_suppressions"]:
             print(f"stale baseline entry: {line}")
-        for key in ("vmem", "comm_budgets", "launch_budgets", "recompile"):
+        for key in ("vmem", "comm_budgets", "comm_time",
+                    "launch_budgets", "recompile"):
             for r in sections.get(key, ()):
                 mark = "ok" if r["ok"] else "FAIL"
                 detail = (f"{r['estimated_mb']}/{r['budget_mb']} MB"
@@ -130,6 +135,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                           f"{r['measured']} B ({r['drop_x']}x vs psum, "
                           f"floor {r['min_drop_x']}x)"
                           if key == "comm_budgets" else
+                          f"{r['measured']*100:.0f}% hidden "
+                          f"({r['exposed_ms']:.3f} ms exposed of "
+                          f"{r['comm_ms']:.3f} ms, floor "
+                          f"{r['budget']*100:.0f}%)"
+                          if key == "comm_time" else
                           f"{r.get('measured', r.get('compiles'))}"
                           f"/{r.get('budget', r.get('max_compiles'))}")
                 print(f"[{mark}] {key}:{r['name']} {detail}")

@@ -307,7 +307,16 @@ _FRAMEWORK_KEYS = {
     "linear_k",            # linear_tree: max path features per leaf model
     "histogram_merge",     # dp merge topology override: "psum" |
                            # "reduce_scatter" | "reduce_scatter_ring" |
-                           # "voting" (default follows tree_learner)
+                           # "reduce_scatter_pipelined" | "voting"
+                           # (default follows tree_learner)
+    "histogram_wire",      # ring-hop wire format: "f32" (default,
+                           # parity-exact) | "bf16" | "int8" (2x/4x fewer
+                           # ring bytes, quality-gated)
+    "merge_chunks",        # pipelined merge: sub-chunks per shard slice
+                           # whose ring hops overlap split scans (def. 4)
+    "mesh_shape",          # dp device topology: "auto" (2-D rows x
+                           # features when D>=8 and F>=64) | "1d" |
+                           # explicit "RxC" e.g. "4x2"
 }
 
 _BOOSTING_ALIASES: Dict[str, str] = {

@@ -301,7 +301,7 @@ def _goss_compact_round(bins, y, w, bag, pred, fmask, hyper: HyperScalars,
                         mono=None, extra_trees=False, col_bins=None,
                         renew_scale=None, ic_member=None,
                         bynode_off=False, hist_merge="psum", n_shards=1,
-                        voting_k=0):
+                        voting_k=0, hist_wire="f32", merge_chunks=4):
     """One compacted GOSS round (shared by the per-round and scanned paths
     — the two MUST stay in RNG lockstep for fused == host training).
 
@@ -354,7 +354,8 @@ def _goss_compact_round(bins, y, w, bag, pred, fmask, hyper: HyperScalars,
         wave_width=wave_width, cat_info=cat_info, axis_name=axis_name,
         mono=mono, extra_trees=extra_trees, col_bins=col_bins,
         ic_member=ic_member, fuse_partition=True, hist_merge=hist_merge,
-        n_shards=n_shards, voting_k=voting_k)
+        n_shards=n_shards, voting_k=voting_k, hist_wire=hist_wire,
+        merge_chunks=merge_chunks)
     if renew_alpha is not None:
         rw = w[idx] * wt
         if renew_scale is not None:
@@ -1053,17 +1054,20 @@ class Booster:
         """Resolve the row-sharded learners' histogram merge topology.
 
         Returns static ``(merge_mode, voting_k)`` for the dp step builders:
-        ``tree_learner="data"`` routes to ``reduce_scatter`` (LightGBM's
-        data-parallel Reduce-Scatter — each shard receives its F/D feature
-        slice, 1/D the comm bytes, serial-parity-exact trees) and
-        ``"voting"`` to the PV-Tree voting merge (``top_k`` ballots,
-        approximate) — they are distinct topologies since r9, not aliases
-        of the full psum.  ``params={'histogram_merge': ...}`` overrides
-        the routing (e.g. ``"psum"`` to A/B the r0 baseline, or
-        ``"reduce_scatter_ring"`` for the ppermute ring decomposition
-        whose hops interleave with partition compute).  Voting needs a
-        numeric-threshold ballot, so categorical datasets fall back to
-        reduce-scatter with a warning.
+        ``tree_learner="data"`` routes to ``reduce_scatter_pipelined``
+        since r10 (LightGBM's data-parallel Reduce-Scatter realized as a
+        chunked ppermute ring — each shard receives its F/D feature
+        slice in sub-chunks whose ring hops overlap the per-chunk split
+        scans; 1/D the comm bytes AND the transfer hidden behind
+        compute, serial-parity-exact trees) and ``"voting"`` to the
+        PV-Tree voting merge (``top_k`` ballots, approximate) — distinct
+        topologies since r9, not aliases of the full psum.
+        ``params={'histogram_merge': ...}`` overrides the routing (e.g.
+        ``"psum"`` to A/B the r0 baseline, ``"reduce_scatter"`` for the
+        fused single-collective scatter, or ``"reduce_scatter_ring"``
+        for the unchunked ring).  Voting needs a numeric-threshold
+        ballot, so categorical datasets fall back to reduce-scatter with
+        a warning.
         """
         import warnings
 
@@ -1071,7 +1075,7 @@ class Booster:
         override = p.extra.get("histogram_merge")
         if override is not None:
             valid = ("psum", "reduce_scatter", "reduce_scatter_ring",
-                     "voting")
+                     "reduce_scatter_pipelined", "voting")
             if override not in valid:
                 raise ValueError(
                     f"histogram_merge must be one of {valid}, "
@@ -1080,7 +1084,7 @@ class Booster:
         elif p.tree_learner == "voting":
             mode = "voting"
         else:
-            mode = "reduce_scatter"
+            mode = "reduce_scatter_pipelined"
         if mode == "voting" and self._cat_key is not None:
             warnings.warn(
                 "tree_learner='voting' does not support categorical "
@@ -1089,6 +1093,121 @@ class Booster:
                 stacklevel=3)
             mode = "reduce_scatter"
         return mode, int(p.top_k)
+
+    def _dp_wire(self, merge_mode: str, eff_rows: int):
+        """Resolve the ring merge's static ``(wire_dtype, merge_chunks)``.
+
+        ``params={'histogram_wire': 'f32'|'bf16'|'int8'}`` compresses
+        ring-hop messages (2x / 4x fewer wire bytes); ``merge_chunks``
+        (default 4) sets the pipelined mode's sub-chunk count.  Non-f32
+        wire needs explicit hop boundaries, so it rejects the fused
+        ``psum`` / ``reduce_scatter`` collectives.
+
+        int8 wire exactness gate: hop messages carry partial-sum COUNT
+        columns, so the quantization step grows with the per-shard row
+        count; past the r9 int8-accumulator bound (``2^31/127`` rows per
+        shard, ``ops.histogram_pallas.INT8_ACC_ROW_LIMIT`` — the same
+        exact-accumulation cliff ``check_int8_row_limit`` guards) the
+        wire's documented tolerance can no longer be honored and the
+        Booster falls back to f32 wire with a warning instead of
+        training silently degraded.  Within the bound, int8 wire is
+        approximate-by-contract (bench quality gate: AUC drift <= 1e-4),
+        NOT parity-exact — only f32 wire keeps the bit-identity bar.
+        """
+        import warnings
+
+        p = self.params
+        wire = str(p.extra.get("histogram_wire", "f32"))
+        from ..ops.histogram import WIRE_DTYPES
+
+        if wire not in WIRE_DTYPES:
+            raise ValueError(
+                f"histogram_wire must be one of {WIRE_DTYPES}, "
+                f"got {wire!r}")
+        chunks = int(p.extra.get("merge_chunks", 4))
+        if chunks < 1:
+            raise ValueError(
+                f"merge_chunks must be >= 1, got {chunks}")
+        if wire == "f32":
+            return wire, chunks
+        if merge_mode not in ("reduce_scatter_ring",
+                              "reduce_scatter_pipelined"):
+            raise ValueError(
+                f"histogram_wire={wire!r} compresses ring-hop messages "
+                f"and needs histogram_merge='reduce_scatter_ring' or "
+                f"'reduce_scatter_pipelined', not {merge_mode!r}")
+        if wire == "int8":
+            from ..ops.histogram_pallas import INT8_ACC_ROW_LIMIT
+
+            mesh = getattr(self, "_dp_mesh", None)
+            n_shards = (int(mesh.shape["data"]) if mesh is not None
+                        else 1)
+            per_shard = -(-int(eff_rows) // max(n_shards, 1))
+            if per_shard > INT8_ACC_ROW_LIMIT:
+                warnings.warn(
+                    f"histogram_wire='int8' with {per_shard:,} rows per "
+                    f"shard exceeds the exact-accumulation bound "
+                    f"({INT8_ACC_ROW_LIMIT:,}); falling back to f32 "
+                    "wire", stacklevel=3)
+                return "f32", chunks
+        return wire, chunks
+
+    def _dp2_shape(self, n_dev: int, n_features: int):
+        """Resolve the data learner's mesh topology: ``None`` for the 1-D
+        row mesh or ``(rows, cols)`` for the 2-D rows x features mesh.
+
+        ``params={'mesh_shape': ...}`` controls it: ``"auto"`` (default)
+        promotes to ``(n_dev//2, 2)`` when ``n_dev >= 8`` and
+        ``n_features >= 64`` — wide-enough data that halving each
+        shard's histogram width beats the wider row slice — ``"1d"``
+        forces the row mesh, and an explicit ``"RxC"`` (e.g. ``"4x2"``)
+        pins the shape.  The 2-D step psum-merges over the data axis
+        (``grow_tree`` rejects ring merges composed with a feature
+        axis), so explicit ``histogram_merge`` / ``histogram_wire``
+        overrides keep the 1-D topology, as do configurations the 2-D
+        step does not trace (multiclass, goss, linear, constraints,
+        categoricals, per-feature bins, per-node sampling).
+        """
+        p = self.params
+        spec = str(p.extra.get("mesh_shape", "auto"))
+        if spec == "1d":
+            return None
+        plain = (p.tree_learner == "data"
+                 and p.boosting in ("gbdt", "rf")
+                 and self._num_class == 1
+                 and not p.linear_tree and not p.extra_trees
+                 and self._mono_key is None and self._ic_key is None
+                 and self._cat_key is None and self._nbins_key is None
+                 and p.feature_fraction_bynode >= 1.0
+                 and p.extra.get("histogram_merge") is None
+                 and p.extra.get("histogram_wire", "f32") == "f32")
+        if spec == "auto":
+            if plain and n_dev >= 8 and n_dev % 2 == 0 \
+                    and n_features >= 64:
+                return n_dev // 2, 2
+            return None
+        try:
+            rows, cols = (int(t) for t in spec.lower().split("x"))
+            if rows < 1 or cols < 1:
+                raise ValueError
+        except ValueError:
+            raise ValueError(
+                f"mesh_shape must be 'auto', '1d', or 'RxC' "
+                f"(e.g. '4x2'), got {spec!r}") from None
+        if cols == 1:
+            return None
+        if not plain:
+            import warnings
+            warnings.warn(
+                f"mesh_shape={spec!r} needs the plain single-class "
+                "gbdt/rf data learner with the default psum-over-rows "
+                "merge; using the 1-D row mesh", stacklevel=4)
+            return None
+        if rows * cols != n_dev:
+            raise ValueError(
+                f"mesh_shape={spec!r} wants {rows * cols} devices but "
+                f"the row-divisible device count is {n_dev}")
+        return rows, cols
 
     def _maybe_setup_dp(self) -> None:
         """Shard the training arrays over the local device mesh when the
@@ -1136,6 +1255,28 @@ class Booster:
             return
         from ..parallel.data_parallel import make_mesh, shard_rows
 
+        shape2 = (None if ranking else self._dp2_shape(
+            n_dev, int(self.train_set.X_binned.shape[1])))
+        if shape2 is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.feature_parallel import (
+                FEATURE_AXIS, make_mesh_2d, pad_features)
+
+            rows, cols = shape2
+            self._dp_mesh = make_mesh_2d(rows, cols)
+            self._dp2 = True
+            ds = self.train_set
+            padded = pad_features(np.asarray(ds.X_binned), cols)
+            self._dp2_width = padded.shape[1]
+            self._dp_bins = jax.device_put(
+                jnp.asarray(padded),
+                NamedSharding(self._dp_mesh, P("data", FEATURE_AXIS)))
+            (self._dp_y, self._dp_w, self._pred_train,
+             self._bag) = shard_rows(
+                self._dp_mesh, ds.y, self._w_eff, self._pred_train,
+                self._bag)
+            return
         self._dp_mesh = make_mesh(n_dev)
         ds = self.train_set
         if ranking:
@@ -1377,6 +1518,25 @@ class Booster:
             tree, new_pred = fn(self._fp_bins, ds.y, self._w_eff, self._bag,
                                 self._pred_train, fmask_p, self._hyper,
                                 round_key)
+        elif getattr(self, "_dp2", False):
+            # 2-D rows x features mesh (r10 default at D>=8, F>=64):
+            # per-block histograms psum over rows, split exchange over
+            # columns — see parallel.feature_parallel.make_dp_fp_train_step
+            from ..parallel.feature_parallel import make_dp_fp_train_step
+
+            fn = make_dp_fp_train_step(
+                self._dp_mesh, self._obj_key, p.num_leaves, self._num_bins,
+                p.extra.get("hist_impl", "auto"),
+                int(p.extra.get("row_chunk", 131072)), p.boosting == "rf",
+                resolve_hist_dtype(p, eff_rows),
+                resolve_wave_width(p, eff_rows))
+            pad_cols = self._dp2_width - int(fmask.shape[0])
+            fmask_p = jnp.concatenate(
+                [fmask, jnp.zeros(pad_cols, jnp.float32)]) \
+                if pad_cols else fmask
+            tree, new_pred = fn(self._dp_bins, self._dp_y, self._dp_w,
+                                self._bag, self._pred_train, fmask_p,
+                                self._hyper, round_key)
         elif getattr(self, "_dp_mesh", None) is not None and \
                 getattr(self, "_dp_stats_only", False):
             from ..parallel.data_parallel import (make_dp_grow_step,
@@ -1388,13 +1548,14 @@ class Booster:
                 [g * bag, h * bag, (bag > 0).astype(jnp.float32)], axis=-1)
             stats = shard_rows(self._dp_mesh, stats)
             merge_mode, voting_k = self._dp_merge_mode()
+            wire_dtype, merge_chunks = self._dp_wire(merge_mode, eff_rows)
             fn = make_dp_grow_step(
                 self._dp_mesh, p.num_leaves, self._num_bins,
                 p.extra.get("hist_impl", "auto"),
                 int(p.extra.get("row_chunk", 131072)),
                 resolve_wave_width(p, eff_rows),
                 resolve_hist_dtype(p, eff_rows),
-                merge_mode, voting_k)
+                merge_mode, voting_k, wire_dtype, merge_chunks)
             tree, row_leaf = fn(self._dp_bins, stats, fmask, self._hyper,
                                 round_key)
             new_pred = self._pred_train + jnp.float32(p.learning_rate) \
@@ -1404,13 +1565,14 @@ class Booster:
             from ..parallel.data_parallel import make_dp_linear_train_step
 
             merge_mode, voting_k = self._dp_merge_mode()
+            wire_dtype, merge_chunks = self._dp_wire(merge_mode, eff_rows)
             fn = make_dp_linear_train_step(
                 self._dp_mesh, self._obj_key, p.num_leaves, self._num_bins,
                 p.extra.get("hist_impl", "auto"),
                 int(p.extra.get("row_chunk", 131072)),
                 resolve_hist_dtype(p, eff_rows),
                 resolve_wave_width(p, eff_rows), self._linear_k,
-                merge_mode, voting_k)
+                merge_mode, voting_k, wire_dtype, merge_chunks)
             tree, new_pred = fn(self._dp_bins, self._dp_y, self._dp_w,
                                 self._bag, self._pred_train, self._dp_xraw,
                                 fmask, self._hyper, round_key)
@@ -1428,6 +1590,7 @@ class Booster:
                 if self._num_class == 1:
                     eff_rows = sum(goss_k_shard)
             merge_mode, voting_k = self._dp_merge_mode()
+            wire_dtype, merge_chunks = self._dp_wire(merge_mode, eff_rows)
             fn = make_dp_train_step(
                 self._dp_mesh, self._obj_key, p.num_leaves, self._num_bins,
                 p.extra.get("hist_impl", "auto"),
@@ -1436,7 +1599,7 @@ class Booster:
                 resolve_hist_dtype(p, eff_rows), goss_k_shard,
                 self._mono_key, p.extra_trees, self._nbins_key,
                 self._num_class, self._ic_key, self._cat_key,
-                merge_mode, voting_k)
+                merge_mode, voting_k, wire_dtype, merge_chunks)
             tree, new_pred = fn(self._dp_bins, self._dp_y, self._dp_w,
                                 self._bag, self._pred_train, fmask,
                                 self._hyper, round_key)

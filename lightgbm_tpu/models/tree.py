@@ -287,9 +287,11 @@ def _fp_column(bins_local: jnp.ndarray, feat_global, axis_name: str,
 
 
 def _make_dist_scorer(axis_name: str, hist_merge: str, n_shards: int,
-                      num_features: int, ctx, cat_info, mono, voting_k: int):
+                      num_features: int, ctx, cat_info, mono, voting_k: int,
+                      merge_chunks: int = 1):
     """Build the batched split scorer for the distributed histogram-merge
-    modes (``reduce_scatter`` / ``reduce_scatter_ring`` / ``voting``).
+    modes (``reduce_scatter`` / ``reduce_scatter_ring`` /
+    ``reduce_scatter_pipelined`` / ``voting``).
 
     Returns ``score(hist_s, masks, depth_ok_s, lo_s, hi_s, po_s, rand_s)
     -> BestSplit`` batched over the leading segment axis, with GLOBAL
@@ -307,13 +309,26 @@ def _make_dist_scorer(axis_name: str, hist_merge: str, n_shards: int,
     the serial scan's first-occurrence tie-break (lowest shard = lowest
     global feature id), which is what makes reduce-scatter mode
     serial-parity-exact.
+
+    Under ``reduce_scatter_pipelined`` the scorer consumes the slice in
+    ``merge_chunks`` static sub-chunks (the units the chunked ring lands):
+    each chunk is scanned by its own ``find_best_split`` call the moment
+    the slice-of-concat dataflow makes it available — XLA's async
+    scheduler can then run chunk ``k``'s ring hops behind chunk ``k−1``'s
+    scan — and the per-chunk winners combine with a first-occurrence
+    argmax over the chunk axis (lowest chunk = lowest feature id, so the
+    serial tie-break survives chunking too).
     """
+    from ..ops.histogram import merge_slice_width
     from ..ops.split import feature_best_gains
     from ..parallel.feature_parallel import reduce_best_split
 
-    rs = hist_merge in ("reduce_scatter", "reduce_scatter_ring")
-    f_pad = -(-num_features // n_shards) * n_shards
-    f_loc = f_pad // n_shards
+    rs = hist_merge in ("reduce_scatter", "reduce_scatter_ring",
+                        "reduce_scatter_pipelined")
+    chunks = (max(int(merge_chunks), 1)
+              if hist_merge == "reduce_scatter_pipelined" else 1)
+    f_loc = merge_slice_width(num_features, n_shards, hist_merge, chunks)
+    f_pad = f_loc * n_shards
 
     def pad_f(a, axis, value):
         if f_pad == num_features:
@@ -334,23 +349,51 @@ def _make_dist_scorer(axis_name: str, hist_merge: str, n_shards: int,
         cat_l = (None if cat_info is None else cat_info._replace(
             is_cat=fslice(cat_info.is_cat, 0, False)))
         mono_l = None if mono is None else fslice(mono, 0, 0)
+        sub = f_loc // chunks           # divisible by construction
+
+        def csl(a, axis, c):            # static chunk window c of a slice
+            return lax.slice_in_dim(a, c * sub, (c + 1) * sub, axis=axis)
 
         def score(hist_s, masks, depth_ok_s, lo_s, hi_s, po_s, rand_s=None):
             masks_l = fslice(masks, 1, 0.0)
-            if rand_s is None:
-                def one(h, m, d, lo, hi, po):
-                    return find_best_split(h, ctx, m, d, cat_l, mono_l,
-                                           lo, hi, po)
+            rand_l = None if rand_s is None else fslice(rand_s, 1, 0)
+            per_chunk = []
+            for c in range(chunks):
+                cat_c = (None if cat_l is None else cat_l._replace(
+                    is_cat=csl(cat_l.is_cat, 0, c)))
+                mono_c = None if mono_l is None else csl(mono_l, 0, c)
+                if rand_l is None:
+                    def one(h, m, d, lo, hi, po,
+                            cat_c=cat_c, mono_c=mono_c):
+                        return find_best_split(h, ctx, m, d, cat_c, mono_c,
+                                               lo, hi, po)
 
-                bs = jax.vmap(one)(hist_s, masks_l, depth_ok_s, lo_s, hi_s,
-                                   po_s)
+                    bs = jax.vmap(one)(csl(hist_s, 1, c), csl(masks_l, 1, c),
+                                       depth_ok_s, lo_s, hi_s, po_s)
+                else:
+                    def one(h, m, d, lo, hi, po, rb,
+                            cat_c=cat_c, mono_c=mono_c):
+                        return find_best_split(h, ctx, m, d, cat_c, mono_c,
+                                               lo, hi, po, rb)
+
+                    bs = jax.vmap(one)(csl(hist_s, 1, c), csl(masks_l, 1, c),
+                                       depth_ok_s, lo_s, hi_s, po_s,
+                                       csl(rand_l, 1, c))
+                if c:
+                    bs = bs._replace(feature=bs.feature + c * sub)
+                per_chunk.append(bs)
+            if chunks == 1:
+                bs = per_chunk[0]
             else:
-                def one(h, m, d, lo, hi, po, rb):
-                    return find_best_split(h, ctx, m, d, cat_l, mono_l,
-                                           lo, hi, po, rb)
-
-                bs = jax.vmap(one)(hist_s, masks_l, depth_ok_s, lo_s, hi_s,
-                                   po_s, fslice(rand_s, 1, 0))
+                # first-occurrence argmax over the chunk axis: gain ties
+                # resolve to the lowest chunk, hence the lowest global
+                # feature id — the serial scan's tie-break, preserved
+                stacked = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                       *per_chunk)
+                win = jnp.argmax(stacked.gain, axis=0)
+                bs = jax.tree.map(
+                    lambda x: jax.vmap(lambda xc, w: xc[w],
+                                       in_axes=(1, 0))(x, win), stacked)
             return jax.vmap(
                 lambda b: reduce_best_split(b, axis_name, f_loc))(bs)
 
@@ -527,6 +570,8 @@ def grow_tree(
     hist_merge: str = "psum",
     n_shards: int = 1,
     voting_k: int = 0,
+    hist_wire: str = "f32",
+    merge_chunks: int = 4,
 ) -> Tuple[Tree, jnp.ndarray]:
     """Grow one best-first tree.
 
@@ -574,6 +619,11 @@ def grow_tree(
         merged — approximate, cheapest).  ``n_shards`` must give the
         static mesh-axis size for the non-psum modes; ``voting_k`` is
         the per-shard ballot size (top-2k candidates merge globally).
+        ``"reduce_scatter_pipelined"`` splits the ring into
+        ``merge_chunks`` sub-rings whose hops interleave with the
+        per-chunk split scans (r10 comm/compute overlap); ``hist_wire``
+        (``"f32"``/``"bf16"``/``"int8"``) compresses ring-hop messages —
+        f32 keeps the exactness bar, bf16/int8 are quality-gated.
 
     Returns:
       (Tree, row_leaf) — row_leaf gives each training row's final leaf node id
@@ -624,7 +674,8 @@ def grow_tree(
             col_bins=col_bins, ic_member=ic_member, wave_tail=wave_tail,
             overgrow_leaves=overgrow_leaves, fp_axis=fp_axis,
             fuse_partition=fuse_partition, hist_merge=hist_merge,
-            n_shards=n_shards, voting_k=voting_k)
+            n_shards=n_shards, voting_k=voting_k, hist_wire=hist_wire,
+            merge_chunks=merge_chunks)
     n, num_features = bins.shape
     capacity = 2 * num_leaves - 1
     max_depth = jnp.asarray(max_depth, jnp.int32)
@@ -648,7 +699,7 @@ def grow_tree(
             "'reduce_scatter' or 'psum'")
     score_dist = (_make_dist_scorer(axis_name, hist_merge, n_shards,
                                     num_features, ctx, cat_info, mono,
-                                    voting_k)
+                                    voting_k, merge_chunks)
                   if dist_mode else None)
 
     # Split-iteration mega-kernel gate (ops.histogram_pallas
@@ -698,7 +749,8 @@ def grow_tree(
         if hist_merge == "voting":
             return h       # local partials; the scorer merges candidates
         return histogram_merge(h, axis_name, mode=hist_merge,
-                               n_shards=n_shards)
+                               n_shards=n_shards, wire_dtype=hist_wire,
+                               n_chunks=merge_chunks)
 
     # ---- root -------------------------------------------------------------
     # under rs the merged root_hist is this shard's [F_pad/D, B, 3] slice;
@@ -1132,6 +1184,8 @@ def grow_tree_frontier(
     hist_merge: str = "psum",
     n_shards: int = 1,
     voting_k: int = 0,
+    hist_wire: str = "f32",
+    merge_chunks: int = 4,
 ) -> Tuple[Tree, jnp.ndarray]:
     """Best-first growth in WAVES: up to ``wave_width`` splits per data pass.
 
@@ -1215,15 +1269,20 @@ def grow_tree_frontier(
             "'reduce_scatter' or 'psum'")
     score_dist = (_make_dist_scorer(axis_name, hist_merge, n_shards,
                                     num_features, ctx, cat_info, mono,
-                                    voting_k)
+                                    voting_k, merge_chunks)
                   if dist_mode else None)
     # per-leaf histogram cache feature extent: the merged SLICE under
     # reduce-scatter (a D-fold cache memory drop — the subtraction trick is
     # linear, so parent - child on slices is the slice of the subtraction);
     # under voting the cache keeps LOCAL unmerged partials (additive too —
-    # the candidate-union merge happens at scoring time)
+    # the candidate-union merge happens at scoring time).  The pipelined
+    # mode pads to a D*chunks multiple, so the slice width comes from the
+    # shared merge_slice_width helper, not ceil(F/D).
     if dist_mode and hist_merge != "voting":
-        f_hist = (-(-num_features // n_shards) * n_shards) // n_shards
+        from ..ops.histogram import merge_slice_width
+
+        f_hist = merge_slice_width(num_features, n_shards, hist_merge,
+                                   merge_chunks)
     else:
         f_hist = num_features
 
@@ -1251,7 +1310,8 @@ def grow_tree_frontier(
         if hist_merge == "voting":
             return h       # local partials; the scorer merges candidates
         return histogram_merge(h, axis_name, mode=hist_merge,
-                               n_shards=n_shards)
+                               n_shards=n_shards, wire_dtype=hist_wire,
+                               n_chunks=merge_chunks)
 
     # ---- root -------------------------------------------------------------
     root_hist = hist_fn(jnp.zeros(n, jnp.int32), 1)[0]      # [f_hist, B, 3]
@@ -1428,7 +1488,9 @@ def grow_tree_frontier(
             if hist_merge != "voting":
                 direct_hist = histogram_merge(direct_hist, axis_name,
                                               mode=hist_merge,
-                                              n_shards=n_shards)
+                                              n_shards=n_shards,
+                                              wire_dtype=hist_wire,
+                                              n_chunks=merge_chunks)
             enc = enc[:n]
             row_leaf = jnp.where(enc > 0, st.n_nodes + enc - 1, p)
         else:

@@ -332,8 +332,73 @@ def pad_feature_axis(hist: jnp.ndarray, n_shards: int,
     return jnp.pad(hist, pads)
 
 
+WIRE_DTYPES = ("f32", "bf16", "int8")
+
+
+def _wire_transfer(t: jnp.ndarray, axis_name: str, perm,
+                   wire_dtype: str, f_axis: int = 1) -> jnp.ndarray:
+    """One ring hop of an f32 partial-sum message in the chosen wire format.
+
+    * ``"f32"`` — plain ``ppermute``; bitwise-exact, 4 B/cell.
+    * ``"bf16"`` — round-to-bf16 on the wire, widen back on arrival;
+      2 B/cell.  Inexact: each hop loses mantissa, so trees carry a
+      documented tolerance (quality-gated, not parity-gated).
+    * ``"int8"`` — symmetric quantization with one f32 scale per
+      (feature, stat) column: ``q = clip(round(t/s), ±127)``, both ``q``
+      and the 12 B/feature scale sidecar travel the ring; 1 B/cell.
+      Per-feature scales matter: grad/hess magnitudes vary by orders of
+      magnitude across features within one message, and a per-tensor
+      scale washes out the small ones (measured: per-tensor flips
+      splits on the bench quality gate, per-feature does not).  Same
+      tolerance contract as bf16.  The EXACT int8 path (accumulate
+      counts in int8 before widening — r9's ``2^31/127`` bound) lives
+      in the accumulator; this is lossy wire compression, which is why
+      the Booster's exactness gate falls back to f32 wire rather than
+      trust the bound alone.
+
+    Quantization happens per HOP, not once: partial sums re-quantize at
+    every shard, so error compounds with ring length — the reason
+    non-f32 wire is only reachable through the ring modes, where the
+    hop boundary exists, and never through the fused ``psum`` /
+    ``psum_scatter`` collectives.
+    """
+    if wire_dtype == "f32":
+        return lax.ppermute(t, axis_name, perm)
+    if wire_dtype == "bf16":
+        return lax.ppermute(t.astype(jnp.bfloat16), axis_name,
+                            perm).astype(jnp.float32)
+    if wire_dtype == "int8":
+        red = tuple(i for i in range(t.ndim)
+                    if i not in (f_axis, t.ndim - 1))
+        s = jnp.max(jnp.abs(t), axis=red, keepdims=True) / 127.0
+        s = jnp.where(s > 0, s, 1.0)
+        q = jnp.clip(jnp.round(t / s), -127, 127).astype(jnp.int8)
+        q = lax.ppermute(q, axis_name, perm)
+        s = lax.ppermute(s, axis_name, perm)
+        return q.astype(jnp.float32) * s
+    raise ValueError(
+        f"unknown wire dtype {wire_dtype!r}; expected one of {WIRE_DTYPES}")
+
+
+def merge_slice_width(num_features: int, n_shards: int,
+                      mode: str = "reduce_scatter",
+                      n_chunks: int = 1) -> int:
+    """Per-shard feature-slice width a merge mode hands the scorer.
+
+    Plain reduce-scatter pads F to a D-multiple; the pipelined mode pads
+    to a ``D * n_chunks`` multiple so every shard slice splits into
+    ``n_chunks`` equal sub-chunks.  Callers that size per-shard buffers
+    (the frontier grower's histogram cache, the dist scorer's metadata
+    slices) must use THIS width, not ``ceil(F/D)``.
+    """
+    mult = n_shards * (n_chunks if mode == "reduce_scatter_pipelined"
+                       else 1)
+    f_pad = -(-num_features // mult) * mult
+    return f_pad // n_shards
+
+
 def ring_reduce_scatter(x: jnp.ndarray, axis_name: str, n_shards: int,
-                        axis: int) -> jnp.ndarray:
+                        axis: int, wire_dtype: str = "f32") -> jnp.ndarray:
     """Reduce-scatter decomposed into ``n_shards - 1`` ``ppermute`` hops.
 
     Chunk ``c``'s partial starts at shard ``c+1`` and travels the ring
@@ -360,12 +425,60 @@ def ring_reduce_scatter(x: jnp.ndarray, axis_name: str, n_shards: int,
 
     acc = chunk(0)
     for k in range(1, n_shards):
-        acc = lax.ppermute(acc, axis_name, perm) + chunk(k)
+        acc = _wire_transfer(acc, axis_name, perm, wire_dtype,
+                             f_axis=axis) + chunk(k)
     return acc
 
 
+def ring_reduce_scatter_pipelined(x: jnp.ndarray, axis_name: str,
+                                  n_shards: int, axis: int, n_chunks: int,
+                                  wire_dtype: str = "f32") -> jnp.ndarray:
+    """:func:`ring_reduce_scatter` split into ``n_chunks`` independent
+    sub-rings along the feature axis — the double-buffered form.
+
+    Each shard's ``f_loc`` slice is cut into ``n_chunks`` equal
+    sub-chunks and every hop ``k`` is emitted for ALL chunks before hop
+    ``k+1`` of any of them, so the chunks' hop-``k`` transfers are
+    mutually independent collectives: on TPU the async scheduler can
+    fly chunk ``k``'s ``ppermute`` while the consumer (the per-chunk
+    split scan downstream) works on chunk ``k−1``'s landed slice.  Every
+    column is still a fixed-order ring sum (the owner's ``idx−1−k``
+    rotation), so the arithmetic contract matches the plain ring's:
+    bitwise identical when the feature padding coincides (``n_chunks==1``
+    or ``F`` already a ``D*n_chunks`` multiple — a wider pad moves a
+    column to a different owner, hence a different rotation of the same
+    addends), f32-rounding-close otherwise.  Tree-level parity with the
+    serial grower is the gate the tests pin — the same bar r9's modes
+    met.
+
+    Requires ``x.shape[axis]`` divisible by ``n_shards * n_chunks``
+    (pad with :func:`pad_feature_axis` using that multiple; see
+    :func:`merge_slice_width`).
+    """
+    f_pad = x.shape[axis]
+    assert f_pad % (n_shards * n_chunks) == 0, \
+        "pad the feature axis to a shards*chunks multiple first"
+    f_loc = f_pad // n_shards
+    sub = f_loc // n_chunks
+    idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    def piece(c, k):
+        start = jnp.mod(idx - 1 - k, n_shards) * f_loc + c * sub
+        return lax.dynamic_slice_in_dim(x, start, sub, axis=axis)
+
+    accs = [piece(c, 0) for c in range(n_chunks)]
+    for k in range(1, n_shards):
+        accs = [_wire_transfer(a, axis_name, perm, wire_dtype,
+                               f_axis=axis) + piece(c, k)
+                for c, a in enumerate(accs)]
+    return jnp.concatenate(accs, axis=axis)
+
+
 def histogram_merge(hist: jnp.ndarray, axis_name: Optional[str],
-                    mode: str = "psum", n_shards: int = 1) -> jnp.ndarray:
+                    mode: str = "psum", n_shards: int = 1,
+                    wire_dtype: str = "f32",
+                    n_chunks: int = 1) -> jnp.ndarray:
     """Merge per-shard partial histograms ``[..., F, B, C]`` over a mesh axis.
 
     The topology choice — LightGBM's data-parallel learner evolution
@@ -385,6 +498,17 @@ def histogram_merge(hist: jnp.ndarray, axis_name: Optional[str],
       * ``"reduce_scatter_ring"`` — same result via an explicit
         :func:`ring_reduce_scatter` (D-1 ppermute hops the scheduler can
         interleave with independent compute).
+      * ``"reduce_scatter_pipelined"`` — the ring split into ``n_chunks``
+        independent sub-rings (:func:`ring_reduce_scatter_pipelined`):
+        chunk ``k``'s hops fly while the scorer scans chunk ``k−1``.
+        f32 wire is bitwise identical to the plain ring; the feature
+        axis pads to a ``D * n_chunks`` multiple, so size metadata
+        slices with :func:`merge_slice_width`.
+
+    ``wire_dtype`` (``"f32"``/``"bf16"``/``"int8"``) compresses ring-hop
+    messages (see :func:`_wire_transfer`); it only exists where a hop
+    boundary exists, so non-f32 wire with ``psum``/``reduce_scatter``
+    (single fused XLA collectives) is a ``ValueError``.
 
     The feature axis is ``ndim - 3`` (histograms are ``[..., F, B, C]``).
     Reduce-scatter modes return the LOCAL padded slice ``[..., F_pad/D, B,
@@ -394,15 +518,31 @@ def histogram_merge(hist: jnp.ndarray, axis_name: Optional[str],
     """
     if axis_name is None:
         return hist
+    if wire_dtype not in WIRE_DTYPES:
+        raise ValueError(
+            f"unknown wire dtype {wire_dtype!r}; expected one of "
+            f"{WIRE_DTYPES}")
+    if wire_dtype != "f32" and mode in ("psum", "reduce_scatter"):
+        raise ValueError(
+            f"wire_dtype={wire_dtype!r} needs a ring merge mode with "
+            f"explicit hop boundaries; {mode!r} lowers to one fused XLA "
+            "collective")
     if mode == "psum":
         return lax.psum(hist, axis_name)
     axis = hist.ndim - 3
+    if mode == "reduce_scatter_pipelined":
+        n_chunks = max(int(n_chunks), 1)
+        padded = pad_feature_axis(hist, n_shards * n_chunks, axis)
+        return ring_reduce_scatter_pipelined(padded, axis_name, n_shards,
+                                             axis, n_chunks, wire_dtype)
     padded = pad_feature_axis(hist, n_shards, axis)
     if mode == "reduce_scatter":
         return lax.psum_scatter(padded, axis_name, scatter_dimension=axis,
                                 tiled=True)
     if mode == "reduce_scatter_ring":
-        return ring_reduce_scatter(padded, axis_name, n_shards, axis)
+        return ring_reduce_scatter(padded, axis_name, n_shards, axis,
+                                   wire_dtype)
     raise ValueError(
         f"unknown histogram merge mode {mode!r}; expected 'psum', "
-        "'reduce_scatter', or 'reduce_scatter_ring'")
+        "'reduce_scatter', 'reduce_scatter_ring', or "
+        "'reduce_scatter_pipelined'")

@@ -93,7 +93,8 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                        goss_k_shard=None, mono_key=None,
                        extra_trees: bool = False, nbins_key=None,
                        num_class: int = 1, ic_key=None, cat_key=None,
-                       merge_mode: str = "psum", voting_k: int = 0):
+                       merge_mode: str = "psum", voting_k: int = 0,
+                       wire_dtype: str = "f32", merge_chunks: int = 4):
     """Build the jitted data-parallel round step for a mesh.
 
     Returns step(bins, y, w, bag, pred, feature_mask, hyper) ->
@@ -109,9 +110,13 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
     merge as usual.
 
     ``merge_mode``: histogram merge topology — ``"psum"`` |
-    ``"reduce_scatter"`` | ``"reduce_scatter_ring"`` | ``"voting"``
+    ``"reduce_scatter"`` | ``"reduce_scatter_ring"`` |
+    ``"reduce_scatter_pipelined"`` | ``"voting"``
     (``voting_k`` = per-shard ballot size); see the module docstring and
-    ``models.tree.grow_tree(hist_merge=...)``.
+    ``models.tree.grow_tree(hist_merge=...)``.  ``wire_dtype`` /
+    ``merge_chunks`` configure the r10 pipelined ring (per-hop wire
+    compression and the sub-chunk count whose hops overlap the per-chunk
+    split scans); both are inert outside the ring modes.
     """
     from ..models.gbdt import _build_cat_info
 
@@ -159,7 +164,8 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                 extra_trees=extra_trees, col_bins=colb,
                 ic_member=ic_member, cat_info=make_cat(bins.shape[1]),
                 hist_merge=merge_mode, n_shards=n_shards,
-                voting_k=voting_k)
+                voting_k=voting_k, hist_wire=wire_dtype,
+                merge_chunks=merge_chunks)
 
         from ..models.gbdt import mc_round_update
         return mc_round_update(grow_one, g, h,
@@ -186,7 +192,8 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                 axis_name=DATA_AXIS, sample_key=sample_key,
                 mono=mono_arr, extra_trees=extra_trees, col_bins=colb,
                 ic_member=ic_member, hist_merge=merge_mode,
-                n_shards=n_shards, voting_k=voting_k)
+                n_shards=n_shards, voting_k=voting_k,
+                hist_wire=wire_dtype, merge_chunks=merge_chunks)
             return tree, new_pred
         stats = jnp.stack([g * bag, h * bag, bag], axis=-1)
         tree, row_leaf = grow_tree(
@@ -197,7 +204,8 @@ def make_dp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
             wave_width=wave_width, mono=mono_arr, extra_trees=extra_trees,
             col_bins=colb, ic_member=ic_member,
             cat_info=make_cat(bins.shape[1]), fuse_partition=True,
-            hist_merge=merge_mode, n_shards=n_shards, voting_k=voting_k)
+            hist_merge=merge_mode, n_shards=n_shards, voting_k=voting_k,
+            hist_wire=wire_dtype, merge_chunks=merge_chunks)
         shrink = jnp.where(is_rf, 1.0, hyper.learning_rate)
         new_pred = pred + shrink * lookup_values(row_leaf, tree.leaf_value)
         return tree, new_pred
@@ -226,7 +234,9 @@ def make_dp_linear_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                               row_chunk: int = 131072,
                               hist_dtype: str = "f32",
                               wave_width: int = 1, linear_k: int = 8,
-                              merge_mode: str = "psum", voting_k: int = 0):
+                              merge_mode: str = "psum", voting_k: int = 0,
+                              wire_dtype: str = "f32",
+                              merge_chunks: int = 4):
     """Data-parallel ``linear_tree`` round (r5 breadth): constant-leaf
     growth shards rows with psum-merged histograms as usual, then every
     leaf's ridge system accumulates per shard and merges with ONE psum of
@@ -253,7 +263,8 @@ def make_dp_linear_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
             row_chunk=row_chunk, hist_dtype=hist_dtype,
             wave_width=wave_width, fuse_partition=True,
             hist_merge=merge_mode, n_shards=mesh.shape[DATA_AXIS],
-            voting_k=voting_k)
+            voting_k=voting_k, hist_wire=wire_dtype,
+            merge_chunks=merge_chunks)
         tree, delta = fit_linear_leaves(
             tree, row_leaf, xraw, g, h, bag, hyper.linear_lambda,
             linear_k, row_chunk, axis_name=DATA_AXIS)
@@ -275,7 +286,8 @@ def make_dp_linear_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
 def make_dp_grow_step(mesh: Mesh, num_leaves: int, num_bins: int,
                       hist_impl: str = "auto", row_chunk: int = 131072,
                       wave_width: int = 1, hist_dtype: str = "f32",
-                      merge_mode: str = "psum", voting_k: int = 0):
+                      merge_mode: str = "psum", voting_k: int = 0,
+                      wire_dtype: str = "f32", merge_chunks: int = 4):
     """Data-parallel growth from PRECOMPUTED per-row stats.
 
     The ranking path: LambdaRank gradients need whole queries (the [Q, G]
@@ -298,7 +310,8 @@ def make_dp_grow_step(mesh: Mesh, num_leaves: int, num_bins: int,
             row_chunk=row_chunk, hist_dtype=hist_dtype,
             wave_width=wave_width, fuse_partition=True,
             hist_merge=merge_mode, n_shards=mesh.shape[DATA_AXIS],
-            voting_k=voting_k)
+            voting_k=voting_k, hist_wire=wire_dtype,
+            merge_chunks=merge_chunks)
         return tree, row_leaf
 
     sharded = shard_map(

@@ -224,7 +224,7 @@ def make_mesh_2d(n_data: int, n_feature: int, devices=None) -> Mesh:
 def make_dp_fp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
                           num_bins: int, hist_impl: str = "auto",
                           row_chunk: int = 131072, is_rf: bool = False,
-                          hist_dtype: str = "f32"):
+                          hist_dtype: str = "f32", wave_width: int = 1):
     """2-D composed round step: each device holds an [n/dr, F/dc] block;
     per-block histograms psum-merge over the DATA axis (the dp allreduce),
     per-column-slice best splits exchange over the FEATURE axis (the fp
@@ -234,6 +234,10 @@ def make_dp_fp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
     step(bins_2dsharded, y, w, bag, pred [all row-sharded],
     fmask_fsharded, hyper, key) -> (tree [replicated],
     new_pred [row-sharded]).
+
+    r10 promotes this topology to the data learner's default at D>=8,
+    F>=64 (Booster._dp2_shape); ``wave_width`` rides through so wave
+    growth composes with both collectives.
     """
     from .data_parallel import DATA_AXIS
 
@@ -248,7 +252,7 @@ def make_dp_fp_train_step(mesh: Mesh, obj_key: tuple, num_leaves: int,
             bins_b, stats, fmask_l, hyper.ctx(), num_leaves, num_bins,
             hyper.max_depth, key=key, axis_name=DATA_AXIS,
             fp_axis=FEATURE_AXIS, hist_impl=hist_impl, row_chunk=row_chunk,
-            hist_dtype=hist_dtype, wave_width=1)
+            hist_dtype=hist_dtype, wave_width=wave_width)
         shrink = jnp.where(is_rf, 1.0, hyper.learning_rate)
         new_pred = pred_l + shrink * lookup_values(row_leaf, tree.leaf_value)
         return tree, new_pred

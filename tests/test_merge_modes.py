@@ -135,6 +135,202 @@ def test_voting_approximate_grows_valid_tree():
     assert live.max() < 136
 
 
+@pytest.mark.parametrize("f", [5, 13, 136])
+@pytest.mark.parametrize("wave_width", [1, 4])
+def test_pipelined_parity_strict_and_wave(f, wave_width):
+    """r10 tentpole exactness bar: the chunked pipelined ring (C=4,
+    f32 wire) grows SERIAL-PARITY-IDENTICAL trees across ragged widths
+    — F=5 < D, F=13 (pads 32 with chunking vs 16 without: different
+    column ownership than plain reduce-scatter, same trees), and the
+    MSLR width F=136 — under both the strict and the wave grower."""
+    assert len(jax.devices()) >= N_DEV
+    _assert_tree_parity(*_grow_pair(f, "reduce_scatter_pipelined",
+                                    wave_width=wave_width))
+
+
+def test_pipelined_multiclass_matches_psum():
+    """Class axis vmapped inside the shard_map over the pipelined merge:
+    per-class chunked rings batch, trees match psum's."""
+    k = 3
+    obj_mc = ("multiclass", 1.0, 1.0, 0.9, 1.0, 0.7, 30, True, k)
+    bins_np, _y, _ = _make_problem(5, n=1024)
+    n = bins_np.shape[0]
+    y_mc = (bins_np[:, 0] % k).astype(np.float32)
+    mesh = make_mesh(N_DEV)
+
+    def run(merge_mode):
+        step = make_dp_train_step(mesh, obj_mc, 7, 16, num_class=k,
+                                  merge_mode=merge_mode)
+        bins, y, w, bag = shard_rows(
+            mesh, jnp.asarray(bins_np), jnp.asarray(y_mc),
+            jnp.ones(n, jnp.float32), jnp.ones(n, jnp.float32))
+        pred = shard_rows(mesh, jnp.zeros((n, k), jnp.float32))
+        fmask = jnp.ones(bins_np.shape[1], jnp.float32)
+        trees, new_pred = step(bins, y, w, bag, pred, fmask,
+                               HyperScalars.from_params(Params()),
+                               jax.random.PRNGKey(1))
+        return jax.device_get(trees), np.asarray(new_pred)
+
+    t_ps, p_ps = run("psum")
+    t_pl, p_pl = run("reduce_scatter_pipelined")
+    np.testing.assert_array_equal(t_ps.split_feature, t_pl.split_feature)
+    np.testing.assert_array_equal(t_ps.split_bin, t_pl.split_bin)
+    np.testing.assert_allclose(p_ps, p_pl, rtol=1e-5, atol=1e-6)
+
+
+def test_pipelined_ranking_stats():
+    """The stats-only dp grow step (ranking path) under the pipelined
+    merge vs serial."""
+    bins_np, _y, stats_np = _make_problem(13, n=1024)
+    mesh = make_mesh(N_DEV)
+    grow = make_dp_grow_step(mesh, 15, 16,
+                             merge_mode="reduce_scatter_pipelined")
+    bins, stats = shard_rows(mesh, jnp.asarray(bins_np),
+                             jnp.asarray(stats_np))
+    fmask = jnp.ones(bins_np.shape[1], jnp.float32)
+    hyper = HyperScalars.from_params(Params())
+    tree_d, _ = grow(bins, stats, fmask, hyper, jax.random.PRNGKey(2))
+
+    tree_s, _ = grow_tree(jnp.asarray(bins_np), jnp.asarray(stats_np),
+                          fmask, hyper.ctx(), 15, 16, hyper.max_depth)
+    np.testing.assert_array_equal(np.asarray(tree_s.split_feature),
+                                  np.asarray(tree_d.split_feature))
+    np.testing.assert_array_equal(np.asarray(tree_s.split_bin),
+                                  np.asarray(tree_d.split_bin))
+
+
+def test_wire_dtypes_close_and_guarded():
+    """bf16/int8 wire formats: merged histograms stay within the
+    documented tolerance of the exact merge, and non-f32 wire refuses
+    the fused collectives (no hop boundary to compress at)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from lightgbm_tpu.ops.histogram import histogram_merge
+    from lightgbm_tpu.utils.compat import shard_map
+
+    s, f, b = 2, 13, 8
+    rng = np.random.RandomState(5)
+    counts = rng.poisson(16, (N_DEV, s, f, b)).astype(np.float32)
+    hist = jnp.asarray(np.stack(
+        [counts * rng.randn(N_DEV, s, f, b).astype(np.float32),
+         counts * 0.25, counts], axis=-1))
+    mesh = Mesh(np.array(jax.devices()[:N_DEV]), ("data",))
+
+    def run(mode, wire):
+        def body(h):
+            return histogram_merge(h[0], "data", mode=mode,
+                                   n_shards=N_DEV, wire_dtype=wire)
+        return np.asarray(jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(P("data"),),
+            out_specs=P("data"), check_vma=False))(hist))
+
+    exact = run("reduce_scatter_ring", "f32")
+    scale = np.abs(exact).max()
+    for wire in ("bf16", "int8"):
+        got = run("reduce_scatter_ring", wire)
+        rel = np.abs(got - exact).max() / scale
+        assert rel < 0.03, (wire, rel)      # documented ring-hop tolerance
+        got_p = run("reduce_scatter_pipelined", wire)
+        assert np.abs(got_p).max() > 0
+    with pytest.raises(ValueError, match="ring merge mode"):
+        run("psum", "int8")
+    with pytest.raises(ValueError, match="ring merge mode"):
+        run("reduce_scatter", "bf16")
+    with pytest.raises(ValueError, match="wire dtype"):
+        run("reduce_scatter_ring", "fp8")
+
+
+def test_mesh_shape_routing():
+    """r10 satellite: 2-D rows x features mesh is the default topology
+    at D>=8, F>=64 (bit-identical predictions to serial); mesh_shape
+    overrides pin or disable it; malformed values die early."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(23)
+    n, f = 1024, 64
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] * 2 + np.sin(X[:, 5] * 3)
+         + rng.normal(0, 0.1, n)).astype(np.float32)
+    base = {"objective": "regression", "num_leaves": 7, "verbosity": -1,
+            "learning_rate": 0.2, "tree_learner": "data"}
+
+    b_ser = lgb.train({k: v for k, v in base.items()
+                       if k != "tree_learner"},
+                      lgb.Dataset(X, label=y), num_boost_round=3)
+    p_ser = b_ser.predict(X)
+
+    b_auto = lgb.train(dict(base), lgb.Dataset(X, label=y),
+                       num_boost_round=3)
+    assert getattr(b_auto, "_dp2", False)
+    assert dict(b_auto._dp_mesh.shape) == {"data": 4, "feature": 2}
+    np.testing.assert_allclose(b_auto.predict(X), p_ser,
+                               rtol=1e-5, atol=1e-6)
+
+    b_1d = lgb.train(dict(base, mesh_shape="1d"),
+                     lgb.Dataset(X, label=y), num_boost_round=3)
+    assert not getattr(b_1d, "_dp2", False)
+    np.testing.assert_allclose(b_1d.predict(X), p_ser,
+                               rtol=1e-5, atol=1e-6)
+
+    b_2x4 = lgb.train(dict(base, mesh_shape="2x4"),
+                      lgb.Dataset(X, label=y), num_boost_round=3)
+    assert dict(b_2x4._dp_mesh.shape) == {"data": 2, "feature": 4}
+    np.testing.assert_allclose(b_2x4.predict(X), p_ser,
+                               rtol=1e-5, atol=1e-6)
+
+    # narrow data stays 1-D under auto (halving the slice buys nothing)
+    b_narrow = lgb.train(dict(base), lgb.Dataset(X[:, :8], label=y),
+                         num_boost_round=2)
+    assert not getattr(b_narrow, "_dp2", False)
+
+    # explicit ring merge keeps the 1-D topology (grow_tree rejects
+    # ring merges composed with a feature axis)
+    b_ring = lgb.train(dict(base, histogram_merge="reduce_scatter"),
+                       lgb.Dataset(X, label=y), num_boost_round=2)
+    assert not getattr(b_ring, "_dp2", False)
+
+    with pytest.raises(ValueError, match="mesh_shape"):
+        lgb.train(dict(base, mesh_shape="coil"),
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+
+
+def test_histogram_wire_override_param():
+    """params={'histogram_wire': ...}: routes through _dp_wire, rejects
+    fused-collective merges, trains within the documented tolerance."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(31)
+    n = 1500
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (X[:, 0] + rng.normal(0, 0.1, n)).astype(np.float32)
+    base = {"objective": "regression", "num_leaves": 7, "verbosity": -1,
+            "tree_learner": "data"}
+    b_f32 = lgb.train(dict(base), lgb.Dataset(X, label=y),
+                      num_boost_round=4)
+    b_q = lgb.train(dict(base, histogram_wire="int8"),
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    assert b_q._dp_wire("reduce_scatter_pipelined", n) == ("int8", 4)
+    # quality, not parity: quantized wire tracks the f32 model loosely
+    mse_f = float(np.mean((b_f32.predict(X) - y) ** 2))
+    mse_q = float(np.mean((b_q.predict(X) - y) ** 2))
+    assert mse_q < 1.5 * mse_f + 1e-3, (mse_f, mse_q)
+    with pytest.raises(ValueError, match="histogram_wire"):
+        lgb.train(dict(base, histogram_wire="fp8"),
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+    with pytest.raises(ValueError, match="reduce_scatter_ring"):
+        lgb.train(dict(base, histogram_merge="psum",
+                       histogram_wire="int8"),
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+    b_c2 = lgb.train(dict(base, merge_chunks=2),
+                     lgb.Dataset(X, label=y), num_boost_round=4)
+    assert b_c2._dp_wire("reduce_scatter_pipelined", n) == ("f32", 2)
+    np.testing.assert_allclose(b_c2.predict(X), b_f32.predict(X),
+                               rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError, match="merge_chunks"):
+        lgb.train(dict(base, merge_chunks=0),
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+
+
 def test_histogram_merge_slices_match_psum():
     """Unit check: each shard's reduce-scatter output equals its feature
     slice of the full psum merge, for both realizations."""
@@ -289,9 +485,63 @@ def test_comm_budget_model_and_gate():
     results = check_comm_budgets()
     assert all(r["ok"] for r in results), results
     assert {r["mode"] for r in results} == {
-        "reduce_scatter", "reduce_scatter_ring", "voting"}
+        "reduce_scatter", "reduce_scatter_ring",
+        "reduce_scatter_pipelined", "voting"}
     with pytest.raises(ValueError):
         hist_merge_comm_bytes("gather", 8, 136, 256, 2)
+
+
+def test_comm_time_model_and_pipelined_budgets():
+    """r10: the comm *time* model.  At the D=8/F=136/B=256 reference the
+    wave's histogram matmul (~2.7 ms) dwarfs ring comm (~50 us), so the
+    pipelined schedule hides all but the first chunk's wire time:
+    hidden_frac = 1 - 1/C = 0.75 at C=4, over the 60% acceptance floor.
+    int8 wire must cut modeled ring bytes >=2x vs r9's 104,960 B/shard."""
+    from lightgbm_tpu.analysis.budgets import (
+        check_comm_time_budgets, comm_budget_by_name,
+        hist_merge_comm_bytes, hist_merge_comm_time)
+
+    # pipelined C=4 pads F=136 -> 160: the slice widens to 20 features
+    pipe = hist_merge_comm_bytes("reduce_scatter_pipelined", 8, 136,
+                                 256, 2)
+    bestsplit = 8 * 16 * 4
+    assert pipe["received_bytes_per_shard"] == 2 * 20 * 256 * 3 * 4 \
+        + bestsplit
+    # int8 wire: 1 B cells + a 12 B per-feature scale sidecar on each of
+    # the (d-1)*chunks hop messages (5 features per message at C=4)
+    q = hist_merge_comm_bytes("reduce_scatter_pipelined", 8, 136, 256, 2,
+                              wire_dtype="int8")
+    assert q["received_bytes_per_shard"] == 2 * 20 * 256 * 3 * 1 \
+        + 7 * 20 * 12 + bestsplit
+    assert 104_960 / q["received_bytes_per_shard"] >= 2.0
+    assert comm_budget_by_name("hist_wire_int8_d8").check()["ok"]
+
+    # wire compression only makes sense where per-hop messages exist
+    with pytest.raises(ValueError, match="ring"):
+        hist_merge_comm_bytes("psum", 8, 136, 256, 2, wire_dtype="int8")
+    with pytest.raises(ValueError, match="wire"):
+        hist_merge_comm_bytes("reduce_scatter_ring", 8, 136, 256, 2,
+                              wire_dtype="fp8")
+
+    t = hist_merge_comm_time("reduce_scatter_pipelined", 8, 136, 256, 2)
+    assert t["compute_bound"]
+    assert abs(t["hidden_frac"] - 0.75) < 1e-9   # 1 - 1/C at C=4
+    assert abs(t["hidden_ms"] + t["exposed_ms"] - t["comm_ms"]) < 1e-9
+    # serial modes expose their full comm time
+    ser = hist_merge_comm_time("reduce_scatter", 8, 136, 256, 2)
+    assert ser["hidden_frac"] == 0.0
+    assert ser["exposed_ms"] == ser["comm_ms"]
+    # comm-bound regime: tiny compute -> makespan is comm-dominated and
+    # only the chunk-0 compute bubble is hidden
+    cb = hist_merge_comm_time("reduce_scatter_pipelined", 8, 136, 256, 2,
+                              rows_per_shard=1)
+    assert not cb["compute_bound"]
+    assert 0.0 < cb["hidden_frac"] < 0.25
+
+    results = check_comm_time_budgets()
+    assert all(r["ok"] for r in results), results
+    assert {r["name"] for r in results} == {
+        "merge_hidden_pipelined_d8", "merge_hidden_pipelined_int8_d8"}
 
 
 def test_int8_overflow_guards():
@@ -348,8 +598,14 @@ def test_histogram_merge_override_param():
     assert b_ps._dp_merge_mode()[0] == "psum"
     b_rs = lgb.train(dict(base), lgb.Dataset(X, label=y),
                      num_boost_round=4)
-    assert b_rs._dp_merge_mode()[0] == "reduce_scatter"
+    # r10: the data learner's default is the pipelined chunked ring
+    assert b_rs._dp_merge_mode()[0] == "reduce_scatter_pipelined"
     np.testing.assert_allclose(b_ps.predict(X), b_rs.predict(X),
+                               rtol=1e-5, atol=1e-5)
+    b_plain = lgb.train(dict(base, histogram_merge="reduce_scatter"),
+                        lgb.Dataset(X, label=y), num_boost_round=4)
+    assert b_plain._dp_merge_mode()[0] == "reduce_scatter"
+    np.testing.assert_allclose(b_ps.predict(X), b_plain.predict(X),
                                rtol=1e-5, atol=1e-5)
     with pytest.raises(ValueError, match="histogram_merge"):
         lgb.train(dict(base, histogram_merge="gather"),

@@ -28,12 +28,15 @@ else
   echo "== ruff == (not installed; skipping style layer)"
 fi
 
-# 2. graftlint: AST rules + baseline + VMEM estimates + comm budgets
+# 2. graftlint: AST rules + baseline + VMEM estimates + comm byte AND
+#    comm TIME budgets (r10: the pipelined merge must keep >=60% of the
+#    ring hidden behind split-scan compute at the D=8/F=136 reference)
 echo "== graftlint =="
 JAX_PLATFORMS=cpu python -m lightgbm_tpu lint
 
-# 3. r9 merge-mode serial parity on the virtual 8-device mesh (fast
-#    subset — the same scenarios tier-1 sees in tests/test_merge_modes.py)
+# 3. merge-mode serial parity on the virtual 8-device mesh (fast
+#    subset — the same scenarios tier-1 sees in tests/test_merge_modes.py;
+#    r10 adds pipelined-chunking parity + wire-dtype guards)
 echo "== merge-mode parity (virtual 8-device mesh) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_merge_modes.py -q \
   -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
