@@ -95,7 +95,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         failed |= any(not r["ok"] for r in res)
 
     # pure arithmetic — always on, like the VMEM estimates
-    from .budgets import check_comm_budgets, check_comm_time_budgets
+    from .budgets import (check_comm_budgets, check_comm_time_budgets,
+                          check_stream_budgets)
 
     res = check_comm_budgets()
     sections["comm_budgets"] = res
@@ -103,6 +104,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     res = check_comm_time_budgets()
     sections["comm_time"] = res
+    failed |= any(not r["ok"] for r in res)
+
+    res = check_stream_budgets()
+    sections["stream_time"] = res
     failed |= any(not r["ok"] for r in res)
 
     if budgets:
@@ -126,7 +131,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not quiet:
         for line in l1["stale_suppressions"]:
             print(f"stale baseline entry: {line}")
-        for key in ("vmem", "comm_budgets", "comm_time",
+        for key in ("vmem", "comm_budgets", "comm_time", "stream_time",
                     "launch_budgets", "recompile"):
             for r in sections.get(key, ()):
                 mark = "ok" if r["ok"] else "FAIL"
@@ -139,7 +144,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                           f"({r['exposed_ms']:.3f} ms exposed of "
                           f"{r['comm_ms']:.3f} ms, floor "
                           f"{r['budget']*100:.0f}%)"
-                          if key == "comm_time" else
+                          if key in ("comm_time", "stream_time") else
                           f"{r.get('measured', r.get('compiles'))}"
                           f"/{r.get('budget', r.get('max_compiles'))}")
                 print(f"[{mark}] {key}:{r['name']} {detail}")

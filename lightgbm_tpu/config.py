@@ -317,6 +317,14 @@ _FRAMEWORK_KEYS = {
     "mesh_shape",          # dp device topology: "auto" (2-D rows x
                            # features when D>=8 and F>=64) | "1d" |
                            # explicit "RxC" e.g. "4x2"
+    "stream_block_rows",   # out-of-core: rows per host block / transfer
+                           # unit (multiple of 256; doubles as the
+                           # streamed histogram row_chunk — def. 131072)
+    "stream_sketch_capacity",  # streaming BinMapper: exact-buffer rows
+                           # per feature before degrading to the GK
+                           # sketch (def. 200k, matching the in-memory
+                           # fit's sample_cnt)
+    "stream_sketch_eps",   # GK sketch rank-error target (def. 1e-3)
 }
 
 _BOOSTING_ALIASES: Dict[str, str] = {

@@ -1,0 +1,23 @@
+"""Out-of-core data subsystem (ISSUE 7).
+
+Streaming BinMapper construction (GK-style mergeable quantile sketches),
+host-resident binned block storage with an async double-buffered
+host->HBM prefetcher, and the streamed per-block training drivers.
+"""
+
+from .block_store import BlockStore
+from .sketch import GKSummary, StreamingBinMapperBuilder
+from .stream_grow import (
+    stream_goss_round,
+    stream_grow_tree,
+    stream_plain_round,
+)
+
+__all__ = [
+    "BlockStore",
+    "GKSummary",
+    "StreamingBinMapperBuilder",
+    "stream_goss_round",
+    "stream_grow_tree",
+    "stream_plain_round",
+]

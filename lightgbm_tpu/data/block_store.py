@@ -1,0 +1,186 @@
+"""Host-resident binned row blocks + async host->HBM prefetch.
+
+The out-of-core regime (ISSUE 7): the ``[n, F]`` binned code matrix no
+longer lives in HBM — it lives here, as packed uint8/uint16 host blocks,
+and the training loop walks them through a DOUBLE-BUFFERED
+``jax.device_put`` pipeline: block ``k+1``'s transfer is issued before
+block ``k``'s histogram pass is consumed, so (dispatch being async) the
+PCIe copy overlaps the compute and the accumulation loop never waits on
+the wire (``analysis.budgets.stream_prefetch_time`` budgets this overlap
+at the reference shape).
+
+Block layout rules — these are load-bearing for BIT-IDENTITY with the
+in-memory grower (tests/test_streaming.py), because f32 accumulation is
+non-associative and the streamed per-block partial sums must replicate
+the in-memory ``_hist_from_segstats`` chunking exactly:
+
+* ``block_rows`` must be a multiple of ``ROW_PAD_MULTIPLE`` (256) and is
+  pinned to the histogram op's ``row_chunk`` by the streamed round;
+* single-block stores (``ceil256(n) <= block_rows``) keep the block at
+  ``ceil256(n)`` rows — matching the in-memory single-chunk dot's
+  contraction length, with NO zero-init accumulate;
+* multi-block stores pad the tail block to EXACTLY ``block_rows`` —
+  matching the in-memory scan's zero-padded chunks — and the consumer
+  accumulates ``acc = zeros; acc += h_k`` for every block in order,
+  matching the scan's zero-init.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Tuple
+
+import numpy as np
+
+from ..dataset import ROW_PAD_MULTIPLE
+
+
+def _check_block_rows(block_rows: int) -> int:
+    block_rows = int(block_rows)
+    if block_rows <= 0 or block_rows % ROW_PAD_MULTIPLE:
+        raise ValueError(
+            f"block_rows={block_rows} must be a positive multiple of "
+            f"{ROW_PAD_MULTIPLE}")
+    return block_rows
+
+
+class BlockStore:
+    """Immutable host store of binned row blocks (see module docstring)."""
+
+    def __init__(self, blocks: List[np.ndarray], num_rows: int,
+                 block_rows: int):
+        if not blocks:
+            raise ValueError("BlockStore needs at least one block")
+        self.blocks = blocks
+        self.num_rows = int(num_rows)
+        self.block_rows = _check_block_rows(block_rows)
+        self.bytes_streamed = 0    # PCIe byte odometer (bench/budget hooks)
+        if len(blocks) > 1:
+            for k, b in enumerate(blocks):
+                if b.shape[0] != self.block_rows:
+                    raise ValueError(
+                        f"multi-block store: block {k} has {b.shape[0]} "
+                        f"rows, expected exactly block_rows="
+                        f"{self.block_rows}")
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self.blocks)
+
+    @property
+    def num_features(self) -> int:
+        return int(self.blocks[0].shape[1])
+
+    @property
+    def padded_rows(self) -> int:
+        """Total padded row extent (the streamed analogue of n_pad)."""
+        return int(sum(b.shape[0] for b in self.blocks))
+
+    @property
+    def nbytes(self) -> int:
+        return int(sum(b.nbytes for b in self.blocks))
+
+    @property
+    def dtype(self):
+        return self.blocks[0].dtype
+
+    def device_blocks(self) -> Iterator[Tuple[int, "object"]]:
+        """Yield ``(row_offset, device_block)`` with one-block lookahead:
+        block k+1's ``jax.device_put`` is issued BEFORE block k is handed
+        to the consumer, so its host->HBM copy runs while the consumer's
+        histogram kernel chews on block k (async dispatch)."""
+        import jax
+
+        nxt = jax.device_put(self.blocks[0])
+        for k in range(len(self.blocks)):
+            cur = nxt
+            if k + 1 < len(self.blocks):
+                nxt = jax.device_put(self.blocks[k + 1])
+            self.bytes_streamed += self.blocks[k].nbytes
+            yield k * self.block_rows, cur
+
+    def gather_rows(self, idx: np.ndarray) -> np.ndarray:
+        """Host-side row gather (GOSS-at-the-source: only the sampled rows
+        cross PCIe, so transferred bytes shrink with the sampling rate)."""
+        idx = np.asarray(idx, np.int64)
+        out = np.empty((len(idx), self.num_features), self.dtype)
+        b = idx // self.block_rows
+        r = idx - b * self.block_rows
+        for k in range(len(self.blocks)):
+            m = b == k
+            if m.any():
+                out[m] = self.blocks[k][r[m]]
+        return out
+
+    @staticmethod
+    def from_binned(codes: np.ndarray, block_rows: int) -> "BlockStore":
+        """Chunk an already-binned [n, F] code matrix per the layout rules
+        (tests and the GOSS full-matrix fallback)."""
+        w = BlockStore.writer(block_rows)
+        w.append(np.asarray(codes))
+        return w.finish()
+
+    @staticmethod
+    def writer(block_rows: int) -> "_BlockWriter":
+        return _BlockWriter(block_rows)
+
+
+class _BlockWriter:
+    """Incremental BlockStore builder: appends arbitrary-length code
+    chunks, emits fixed ``block_rows`` blocks, applies the single-block /
+    padded-tail finalize rules."""
+
+    def __init__(self, block_rows: int):
+        self.block_rows = _check_block_rows(block_rows)
+        self._blocks: List[np.ndarray] = []
+        self._carry: List[np.ndarray] = []
+        self._carry_rows = 0
+        self._num_rows = 0
+        self._dtype = None
+        self._num_features = None
+
+    def append(self, codes: np.ndarray) -> "_BlockWriter":
+        codes = np.asarray(codes)
+        if codes.ndim != 2:
+            raise ValueError(f"code chunks must be 2-D, got {codes.shape}")
+        if self._dtype is None:
+            self._dtype = codes.dtype
+            self._num_features = int(codes.shape[1])
+        elif codes.dtype != self._dtype:
+            raise ValueError(
+                f"code dtype {codes.dtype} != first chunk's {self._dtype}")
+        elif int(codes.shape[1]) != self._num_features:
+            raise ValueError(
+                f"ragged feature counts: {codes.shape[1]} vs "
+                f"{self._num_features}")
+        self._num_rows += int(codes.shape[0])
+        self._carry.append(codes)
+        self._carry_rows += int(codes.shape[0])
+        while self._carry_rows >= self.block_rows:
+            buf = np.concatenate(self._carry, axis=0)
+            self._blocks.append(np.ascontiguousarray(buf[:self.block_rows]))
+            rest = buf[self.block_rows:]
+            self._carry = [rest] if rest.shape[0] else []
+            self._carry_rows = int(rest.shape[0])
+        return self
+
+    def finish(self) -> BlockStore:
+        if self._num_rows == 0:
+            raise ValueError("no rows appended")
+        n = self._num_rows
+        n_pad = -(-n // ROW_PAD_MULTIPLE) * ROW_PAD_MULTIPLE
+        carry = (np.concatenate(self._carry, axis=0) if self._carry
+                 else np.zeros((0, self._num_features), self._dtype))
+        if not self._blocks:
+            # single block: pad to ceil256(n) ONLY (no zero-init add on the
+            # consumer side — mirrors the in-memory single-chunk dot)
+            blk = np.zeros((n_pad, self._num_features), self._dtype)
+            blk[:carry.shape[0]] = carry
+            blocks = [np.ascontiguousarray(blk)]
+        else:
+            blocks = self._blocks
+            if carry.shape[0]:
+                tail = np.zeros((self.block_rows, self._num_features),
+                                self._dtype)
+                tail[:carry.shape[0]] = carry
+                blocks = blocks + [np.ascontiguousarray(tail)]
+        return BlockStore(blocks, n, self.block_rows)

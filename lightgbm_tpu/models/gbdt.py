@@ -919,16 +919,58 @@ class Booster:
             self._nbins_key = tuple(int(x) for x in colb)
         else:
             self._nbins_key = None
+        self._streamed = bool(getattr(ds, "is_streamed", False))
+        if self._streamed:
+            self._check_streamed_scope()
         self._xraw = None
         self._linear_k = None
         if p.linear_tree:
             self._setup_linear_tree()
         self._dp_mesh = None
         self._fp_mesh = None
-        if p.tree_learner == "feature":
+        if self._streamed:
+            if p.tree_learner != "serial":
+                import warnings
+
+                warnings.warn(
+                    f"tree_learner='{p.tree_learner}' is ignored under "
+                    "streamed (from_blocks) training — the block loop is a "
+                    "host loop; falling back to serial")
+        elif p.tree_learner == "feature":
             self._maybe_setup_fp()
         elif p.tree_learner in ("data", "voting"):
             self._maybe_setup_dp()
+
+    def _check_streamed_scope(self) -> None:
+        """Out-of-core training covers the PLAIN numeric path (ISSUE 7):
+        the per-block grower kernels replicate the fused strict/wave
+        bodies without the categorical / monotone / extra-trees /
+        interaction / bynode machinery, and multiclass & ranking need
+        per-round state the streamed round functions don't carry.  Reject
+        the rest loudly rather than train something subtly different."""
+        p = self.params
+        bad = None
+        if self._num_class > 1:
+            bad = "multiclass objectives"
+        elif getattr(self.obj, "needs_group", False):
+            bad = f"ranking objective '{self.obj.name}'"
+        elif p.linear_tree:
+            bad = "linear_tree"
+        elif p.extra_trees:
+            bad = "extra_trees"
+        elif self._mono_key is not None:
+            bad = "monotone_constraints"
+        elif self._ic_key is not None:
+            bad = "interaction_constraints"
+        elif self._cat_key is not None:
+            bad = "categorical features"
+        elif p.feature_fraction_bynode < 1.0:
+            bad = "feature_fraction_bynode < 1"
+        elif p.boosting == "dart":
+            bad = "boosting='dart'"
+        if bad is not None:
+            raise ValueError(
+                f"streamed (from_blocks) training does not support {bad}")
 
     def _resolve_monotone_constraints(self) -> Optional[tuple]:
         """Map user ``monotone_constraints`` (per ORIGINAL feature) onto the
@@ -1468,7 +1510,8 @@ class Booster:
                 # device, and leaving it there would reshard every round
                 from ..parallel.data_parallel import shard_rows
                 self._bag = shard_rows(self._dp_mesh, self._bag)
-        n_cols = int(ds.X_binned.shape[1])
+        n_cols = int(ds.num_feature_)  # == X_binned.shape[1]; X_binned is
+        # None under streaming (the codes live in ds.block_store)
         if p.feature_fraction < 1.0:
             fkey = jax.random.fold_in(
                 jax.random.PRNGKey(p.feature_fraction_seed + p.seed), i)
@@ -1502,7 +1545,31 @@ class Booster:
             p, eff_rows,
             int(_dp_m.shape["data"]) if _dp_m is not None else 1)
         round_key = jax.random.fold_in(self._key, i)
-        if getattr(self, "_fp_mesh", None) is not None:
+        if getattr(self, "_streamed", False):
+            from ..data.stream_grow import (stream_goss_round,
+                                            stream_plain_round)
+
+            renew_alpha = getattr(self.obj, "renew_alpha", None)
+            renew_scale = getattr(self.obj, "renew_scale", None)
+            hist_impl = p.extra.get("hist_impl", "auto")
+            hist_dtype = resolve_hist_dtype(p, eff_rows)
+            wave_width = resolve_wave_width(p, eff_rows)
+            if goss_k is not None:
+                tree, new_pred = stream_goss_round(
+                    ds.block_store, self._obj_key, ds.y, self._w_eff,
+                    self._bag, self._pred_train, fmask, self._hyper,
+                    round_key, goss_k, float(p.top_rate),
+                    float(p.other_rate), p.seed * 1_000_003 + i,
+                    p.num_leaves, self._num_bins, hist_impl, hist_dtype,
+                    wave_width, renew_alpha, renew_scale)
+            else:
+                tree, new_pred = stream_plain_round(
+                    ds.block_store, self._obj_key, ds.y, self._w_eff,
+                    self._bag, self._pred_train, fmask, self._hyper,
+                    p.num_leaves, self._num_bins, hist_impl, hist_dtype,
+                    wave_width, p.boosting == "rf", renew_alpha,
+                    renew_scale)
+        elif getattr(self, "_fp_mesh", None) is not None:
             from ..parallel.feature_parallel import make_fp_train_step
 
             fn = make_fp_train_step(
@@ -1657,6 +1724,7 @@ class Booster:
         return (self._num_class == 1
                 and getattr(self, "_dp_mesh", None) is None
                 and getattr(self, "_fp_mesh", None) is None
+                and not getattr(self, "_streamed", False)
                 and p.boosting in ("gbdt", "rf", "goss")
                 and not p.linear_tree
                 and not self._valid)
@@ -1924,6 +1992,12 @@ class Booster:
 
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         data.construct()
+        if getattr(data, "is_streamed", False):
+            raise ValueError(
+                f"valid set '{name}' is a streamed (from_blocks) dataset — "
+                "incremental valid-set scoring needs a resident binned "
+                "matrix; bin the valid set in memory with "
+                "reference=<streamed train set> instead")
         if data.y is None:
             raise ValueError(f"valid set '{name}' requires a label")
         k = self._num_class

@@ -1732,6 +1732,369 @@ def grow_tree_frontier(
     return tree, st.row_leaf
 
 
+# ---------------------------------------------------------------------------
+# Streamed (out-of-core) grower helpers — ISSUE 7.
+#
+# The in-memory growers trace the whole tree as ONE device program (fori/
+# while loops over a resident [n, F] matrix).  Under out-of-core training
+# the matrix lives host-side in a data.BlockStore and each histogram pass
+# is a HOST loop over prefetched blocks, so the growers decompose into
+# jitted pieces: per-block partition+histogram kernels (row-axis work,
+# called once per block) and per-iteration table updates (node-table-sized
+# work, called once per split/wave).  Every piece replicates the
+# corresponding in-memory computation VERBATIM on the plain numeric path
+# (no categorical/monotone/extra-trees/interaction/bynode/distributed) —
+# combined with the BlockStore's chunk-replicating layout rules, streamed
+# trees are BIT-IDENTICAL to `grow_tree(..., row_chunk=block_rows)`
+# (tests/test_streaming.py).  The host drivers live in data/stream_grow.py.
+# ---------------------------------------------------------------------------
+
+
+def _stream_root_core(root_hist, ctx, feature_mask):
+    """Root output + candidate from an accumulated [F, B, 3] histogram
+    (the streamed analogue of the growers' shared root block)."""
+    root_tot = jnp.sum(root_hist[0], axis=0)                 # (g, h, c)
+    root_out = constrained_leaf_output(
+        root_tot[0], root_tot[1], root_tot[2],
+        ctx._replace(path_smooth=jnp.float32(0.0)),
+        jnp.float32(-jnp.inf), jnp.float32(jnp.inf), jnp.float32(0.0))
+    root_best = find_best_split(root_hist, ctx, feature_mask,
+                                jnp.bool_(True), None, mono=None,
+                                parent_out=root_out, rand_bins=None)
+    return root_out, root_tot, root_best
+
+
+@functools.partial(jax.jit, static_argnames=("capacity",))
+def stream_strict_init(root_hist, ctx, feature_mask, capacity):
+    """Packed root table + the fused strict grower's aux pick row."""
+    root_out, root_tot, root_best = _stream_root_core(root_hist, ctx,
+                                                      feature_mask)
+    P0 = _packed_root_table(capacity, root_out, root_tot, root_best, None)
+    f32 = jnp.float32
+    zero = jnp.float32(0.0)
+    aux0 = jnp.stack([
+        zero, root_best.feature.astype(f32), root_best.bin.astype(f32),
+        jnp.isfinite(root_best.gain).astype(f32),
+        zero, zero, zero, zero]).reshape(1, 8)
+    return P0, aux0
+
+
+@functools.partial(jax.jit, static_argnames=("capacity", "grow_leaves"))
+def stream_wave_init(root_hist, ctx, feature_mask, capacity, grow_leaves):
+    """Packed root table + per-leaf histogram cache for the wave grower."""
+    root_out, root_tot, root_best = _stream_root_core(root_hist, ctx,
+                                                      feature_mask)
+    P0 = _packed_root_table(capacity, root_out, root_tot, root_best, None)
+    cache0 = jnp.zeros((grow_leaves,) + root_hist.shape,
+                       jnp.float32).at[0].set(root_hist)
+    slot0 = jnp.full((capacity,), 0, jnp.int32)
+    return P0, cache0, slot0
+
+
+@functools.lru_cache(maxsize=None)
+def _stream_root_block_fn(num_bins: int, block_rows: int, hist_impl: str,
+                          hist_dtype: str):
+    """Per-block root histogram partial [1, F, B, 3].
+
+    ``row_chunk`` is pinned to ``block_rows`` so each block takes the
+    single-chunk direct path of ``_hist_from_segstats`` — the SAME dot the
+    in-memory op's scan body runs per chunk, which is what makes the
+    block-wise partial sum bit-identical to the in-memory accumulation.
+    """
+    from ..ops.histogram import batched_histogram_op
+
+    op = batched_histogram_op(1, num_bins, block_rows, hist_impl,
+                              hist_dtype)
+
+    @jax.jit
+    def blk(bins_b, stats_full, off):
+        nb = bins_b.shape[0]
+        stats_b = lax.dynamic_slice(stats_full, (off, jnp.int32(0)),
+                                    (nb, 3))
+        return op(bins_b, stats_b, jnp.zeros((nb,), jnp.int32))
+
+    return blk
+
+
+@functools.lru_cache(maxsize=None)
+def _stream_strict_block_fn(num_bins: int, block_rows: int, hist_impl: str,
+                            hist_dtype: str):
+    """One strict split iteration's ROW-AXIS work for one block: partition
+    the split leaf's rows and build the {left, right, other} histogram
+    partial — a verbatim per-block restatement of the fused strict body's
+    XLA prologue (grow_tree's ``body_f``)."""
+    from ..ops.histogram import batched_histogram_op
+
+    op = batched_histogram_op(2, num_bins, block_rows, hist_impl,
+                              hist_dtype)
+
+    @jax.jit
+    def blk(bins_b, stats_full, row_leaf_full, off, aux, n_nodes):
+        nb = bins_b.shape[0]
+        leaf = aux[0, 0].astype(jnp.int32)
+        feat = aux[0, 1].astype(jnp.int32)
+        thr = aux[0, 2].astype(jnp.int32)
+        active = aux[0, 3] > 0
+        nl, nr = n_nodes, n_nodes + 1
+        rl_b = lax.dynamic_slice(row_leaf_full, (off,), (nb,))
+        stats_b = lax.dynamic_slice(stats_full, (off, jnp.int32(0)),
+                                    (nb, 3))
+        col = jnp.take(bins_b.astype(jnp.int32), feat, axis=1)
+        go_left = col <= thr
+        new_rl = jnp.where(rl_b == leaf,
+                           jnp.where(go_left, nl, nr), rl_b)
+        rl2 = jnp.where(active, new_rl, rl_b)
+        seg = jnp.where(rl2 == nl, 0,
+                        jnp.where(rl2 == nr, 1, 2)).astype(jnp.int32)
+        h = op(bins_b, stats_b, seg)                     # [2, F, B, 3]
+        return lax.dynamic_update_slice(row_leaf_full, rl2, (off,)), h
+
+    return blk
+
+
+@jax.jit
+def stream_strict_update(hist2, P, aux, feature_mask, ctx, max_depth,
+                         n_nodes, n_leaves):
+    """One strict split iteration's TABLE work: the split-iteration
+    mega-kernel on the block-accumulated histogram (same call the fused
+    in-memory body makes)."""
+    from ..ops.histogram_pallas import split_iter_pallas
+
+    f32 = jnp.float32
+    zero = jnp.float32(0.0)
+    num_features = feature_mask.shape[0]
+    fmask_row = feature_mask.astype(f32).reshape(1, num_features)
+    md_f = jnp.asarray(max_depth, jnp.int32).astype(f32)
+    scal = jnp.stack([
+        jnp.asarray(ctx.lambda_l1, f32),
+        jnp.asarray(ctx.lambda_l2, f32),
+        jnp.asarray(ctx.min_data_in_leaf, f32),
+        jnp.asarray(ctx.min_sum_hessian, f32),
+        jnp.asarray(ctx.min_gain_to_split, f32),
+        jnp.asarray(ctx.max_delta_step, f32),
+        jnp.asarray(ctx.path_smooth, f32),
+        md_f, n_nodes.astype(f32),
+        zero, zero, zero, zero, zero, zero, zero]).reshape(1, 16)
+    P2, aux2 = split_iter_pallas(hist2.transpose(0, 1, 3, 2), P, fmask_row,
+                                 aux, scal, pk=_PK)
+    grew = jnp.where(aux[0, 3] > 0, 1, 0).astype(jnp.int32)
+    return P2, aux2, n_nodes + 2 * grew, n_leaves + grew
+
+
+@functools.lru_cache(maxsize=None)
+def _stream_wave_block_fn(w_width: int, num_bins: int, num_features: int,
+                          block_rows: int, hist_impl: str, hist_dtype: str):
+    """One wave's ROW-AXIS work for one block: table-lookup routing of the
+    wave's splitting leaves + the direct-child histogram partial — the
+    non-fused wave body's steps 2–3 restated per block."""
+    from ..ops.histogram import batched_histogram_op
+
+    op = batched_histogram_op(w_width, num_bins, block_rows, hist_impl,
+                              hist_dtype)
+    # same gate as the in-memory wave body (fp_axis is None here):
+    # DEFAULT-precision (bf16) lookups are exact only while every table
+    # value is an integer <= 256
+    exact_in_bf16 = max(num_features, 2 * w_width, num_bins) <= 256
+
+    @jax.jit
+    def blk(bins_b, stats_full, row_leaf_full, off, tbl, n_nodes):
+        f32 = jnp.float32
+        nb = bins_b.shape[0]
+        p = lax.dynamic_slice(row_leaf_full, (off,), (nb,))
+        stats_b = lax.dynamic_slice(stats_full, (off, jnp.int32(0)),
+                                    (nb, 3))
+        bins_i32 = bins_b.astype(jnp.int32)
+        pv = lookup_rows(p, tbl,
+                         precision=(lax.Precision.DEFAULT if exact_in_bf16
+                                    else lax.Precision.HIGHEST))
+        psel = pv[:, 0] > 0
+        feat_r = pv[:, 1].astype(jnp.int32)
+        thr_r = pv[:, 2]
+        fmatch = (feat_r[:, None]
+                  == lax.iota(jnp.int32, num_features)[None, :])
+        v = jnp.sum(jnp.where(fmatch, bins_i32, 0), axis=1)
+        go_left = v.astype(f32) <= thr_r
+        rank2_r = pv[:, 3].astype(jnp.int32)
+        child = n_nodes + rank2_r + jnp.where(go_left, 0, 1)
+        row_leaf = jnp.where(psel, child, p)
+        to_direct = psel & (go_left == (pv[:, 4] > 0))
+        seg_id = jnp.where(to_direct, rank2_r >> 1, w_width)
+        h = op(bins_b, stats_b, seg_id)                  # [W, F, B, 3]
+        return lax.dynamic_update_slice(row_leaf_full, row_leaf, (off,)), h
+
+    return blk
+
+
+@functools.lru_cache(maxsize=None)
+def _stream_wave_fns(capacity: int, w_width: int, grow_leaves: int,
+                     num_features: int, num_bins: int, wave_tail: str):
+    """(plan, update, cond) for the streamed wave grower.
+
+    ``plan`` emits the [capacity, 5] routing table the per-block kernel
+    consumes; ``update`` re-derives the wave plan from the SAME packed
+    table (deterministic — identical jitted ops on identical inputs) and
+    then runs the in-memory wave body's steps 4–7 verbatim; ``cond`` is
+    the while-loop predicate, synced to host once per wave by the driver.
+    """
+    exact = wave_tail == "exact"
+    neg_inf = jnp.float32(-jnp.inf)
+    m = capacity
+    iota_w = lax.iota(jnp.int32, w_width)
+    K = _PK
+
+    def _plan(P, n_leaves):
+        gains = jnp.where(P[:, K.IS_LEAF] > 0.5, P[:, K.CAND_GAIN], neg_inf)
+        sel_key = (jnp.where(P[:, K.IS_LEAF] > 0.5, P[:, K.PM], neg_inf)
+                   if exact else gains)
+        order = jnp.argsort(-sel_key, stable=True)
+        rank = jnp.zeros(m, jnp.int32).at[order].set(lax.iota(jnp.int32, m))
+        budget = grow_leaves - n_leaves
+        n_cand = jnp.sum(jnp.isfinite(gains)).astype(jnp.int32)
+        if wave_tail == "half":
+            alloc = jnp.maximum(jnp.int32(1), budget // 2)
+        else:  # "greedy" / "exact"
+            alloc = budget
+        s = jnp.minimum(jnp.minimum(n_cand, alloc), jnp.int32(w_width))
+        sel = jnp.isfinite(gains) & (rank < s)
+        parent_r = order[:w_width]
+        active_r = iota_w < s
+        prow = P[parent_r]
+        direct_left = prow[:, K.CAND_LC] <= prow[:, K.CAND_RC]
+        dl_of = _scatter(jnp.full((m,), True), parent_r, direct_left,
+                         active_r)
+        return (gains, rank, s, sel, parent_r, active_r, prow, direct_left,
+                dl_of)
+
+    @jax.jit
+    def plan(P, n_leaves):
+        f32 = jnp.float32
+        _, rank, _, sel, _, _, _, _, dl_of = _plan(P, n_leaves)
+        return jnp.stack([sel.astype(f32), P[:, K.CAND_FEAT],
+                          P[:, K.CAND_BIN], (2 * rank).astype(f32),
+                          dl_of.astype(f32)], axis=1)       # [M, 5]
+
+    @jax.jit
+    def update(P, hist_cache, node_slot, n_nodes, n_leaves, direct_hist,
+               feature_mask, ctx, max_depth):
+        f32 = jnp.float32
+        (gains, _, s, _, parent_r, active_r, prow, direct_left,
+         _) = _plan(P, n_leaves)
+        nl_r = n_nodes + 2 * iota_w
+        nr_r = nl_r + 1
+
+        # step 4: sibling = parent - child from the per-leaf cache
+        fb3 = num_features * num_bins * 3
+        cache_flat = hist_cache.reshape(grow_leaves, fb3)
+        parent_slot = node_slot[parent_r]
+        oh_p = (parent_slot[:, None]
+                == lax.iota(jnp.int32, grow_leaves)[None, :])
+        parent_hist = lax.dot_general(
+            oh_p.astype(f32), cache_flat,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
+        ).reshape(w_width, num_features, num_bins, 3)
+        other_hist = parent_hist - direct_hist
+        dl = direct_left[:, None, None, None]
+        left_hist = jnp.where(dl, direct_hist, other_hist)
+        right_hist = jnp.where(dl, other_hist, direct_hist)
+        left_slot = parent_slot
+        right_slot = n_leaves + iota_w
+        slot2 = jnp.concatenate([left_slot, right_slot])
+        act2w = jnp.concatenate([active_r, active_r])
+        slot2m = jnp.where(act2w, slot2, -1)
+        q = (lax.iota(jnp.int32, grow_leaves)[:, None] == slot2m[None, :])
+        keep = 1.0 - jnp.any(q, axis=1).astype(f32)
+        newvals = jnp.concatenate([left_hist, right_hist])
+        cache = (cache_flat * keep[:, None] + lax.dot_general(
+            q.astype(f32), newvals.reshape(2 * w_width, fb3),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
+        )).reshape(hist_cache.shape)
+        node_slot2 = _scatter(node_slot, nl_r, left_slot, active_r)
+        node_slot2 = _scatter(node_slot2, nr_r, right_slot, active_r)
+
+        # step 5: child bounds (plain path: mono is None -> pass-through)
+        wl_w, wr_w = prow[:, K.CAND_WL], prow[:, K.CAND_WR]
+        lo_w, hi_w = prow[:, K.BOUND_LO], prow[:, K.BOUND_HI]
+        lo_l, hi_l, lo_r, hi_r = lo_w, hi_w, lo_w, hi_w
+
+        # step 6: score the 2W fresh children from the cache
+        child_nodes = jnp.concatenate([nl_r, nr_r])
+        child_hists = jnp.concatenate([left_hist, right_hist])
+        child_depth1 = prow[:, K.DEPTH] + 1.0
+        child_depth = jnp.concatenate([child_depth1, child_depth1])
+        md = jnp.asarray(max_depth, jnp.int32)
+        depth_ok = (md <= 0) | (child_depth < md.astype(f32))
+        child_masks = jnp.broadcast_to(feature_mask,
+                                       (2 * w_width, num_features))
+        child_lo = jnp.concatenate([lo_l, lo_r])
+        child_hi = jnp.concatenate([hi_l, hi_r])
+        child_vals = jnp.concatenate([wl_w, wr_w])
+
+        def score(h, mm, d, lo_, hi_, po):
+            return find_best_split(h, ctx, mm, d, None, None, lo_, hi_, po)
+
+        bs = jax.vmap(score)(child_hists, child_masks, depth_ok, child_lo,
+                             child_hi, child_vals)
+        active_2 = jnp.concatenate([active_r, active_r])
+
+        # step 7: commit (two packed row scatters)
+        parent_rows = prow.at[:, jnp.array([
+            K.SPLIT_FEAT, K.SPLIT_BIN, K.LEFT, K.RIGHT, K.IS_LEAF,
+            K.SPLIT_GAIN])].set(jnp.stack([
+                prow[:, K.CAND_FEAT], prow[:, K.CAND_BIN],
+                nl_r.astype(f32), nr_r.astype(f32),
+                jnp.zeros(w_width), gains[parent_r]], axis=-1))
+        child_rows = jnp.stack([
+            jnp.full((2 * w_width,), -1.0),              # SPLIT_FEAT
+            jnp.zeros((2 * w_width,)),                   # SPLIT_BIN
+            jnp.full((2 * w_width,), -1.0),              # LEFT
+            jnp.full((2 * w_width,), -1.0),              # RIGHT
+            child_vals,                                  # LEAF_VALUE
+            jnp.ones((2 * w_width,)),                    # IS_LEAF
+            jnp.concatenate([prow[:, K.CAND_LC],
+                             prow[:, K.CAND_RC]]),       # COUNT
+            jnp.zeros((2 * w_width,)),                   # SPLIT_GAIN
+            child_depth,                                 # DEPTH
+            bs.gain,                                     # CAND_GAIN
+            bs.feature.astype(f32),                      # CAND_FEAT
+            bs.bin.astype(f32),                          # CAND_BIN
+            bs.left_g, bs.left_h, bs.left_c,
+            bs.right_g, bs.right_h, bs.right_c,
+            bs.left_out,                                 # CAND_WL
+            bs.right_out,                                # CAND_WR
+            child_lo,                                    # BOUND_LO
+            child_hi,                                    # BOUND_HI
+            jnp.zeros((2 * w_width,)),                   # CAND_CAT
+            jnp.minimum(jnp.concatenate([prow[:, K.PM], prow[:, K.PM]]),
+                        bs.gain),                        # PM
+        ], axis=-1)                                      # [2W, NC]
+        oob = jnp.int32(capacity)
+        P2 = P.at[jnp.where(active_r, parent_r, oob)].set(
+            parent_rows, mode="drop")
+        kid_idx = jnp.where(active_2, child_nodes, oob)
+        P2 = P2.at[kid_idx].set(child_rows, mode="drop")
+        return (P2, cache, node_slot2, n_nodes + 2 * s, n_leaves + s)
+
+    @jax.jit
+    def cond(P, n_leaves):
+        gains = jnp.where(P[:, K.IS_LEAF] > 0.5, P[:, K.CAND_GAIN], neg_inf)
+        return (n_leaves < grow_leaves) & jnp.any(jnp.isfinite(gains))
+
+    return plan, update, cond
+
+
+@functools.partial(jax.jit, static_argnames=("num_leaves",))
+def stream_exact_prune(P, row_leaf, num_leaves):
+    """Exact-tail replay for the streamed wave grower (plain numeric path:
+    no categorical masks)."""
+    newP, _, row_leaf_new, n_leaves_f = _exact_prune(P, None, row_leaf,
+                                                     num_leaves, None)
+    return newP, row_leaf_new, n_leaves_f
+
+
 def empty_forest(num_trees: int, num_leaves: int) -> Tree:
     """Stacked all-stump forest used as a fixed-capacity accumulator."""
     capacity = 2 * num_leaves - 1

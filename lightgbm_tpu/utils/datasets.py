@@ -89,6 +89,33 @@ def make_higgs_like(n: int = 1_000_000, num_features: int = 28,
     return X, y
 
 
+def iter_higgs_like_blocks(n: int = 1_000_000, num_features: int = 28,
+                           seed: int = 0, block_rows: int = 131_072):
+    """Yield ``(X_block, y_block)`` pairs of the Higgs-like task without
+    ever materializing the full matrix — the host-memory companion to
+    ``Dataset.from_blocks``.
+
+    Each block draws from its own ``default_rng((seed, b))`` stream, so
+    block ``b`` is reproducible in isolation (a re-iterated generator
+    yields identical blocks — ``from_blocks`` needs two passes).  The
+    signal vector ``w`` comes from the same fixed stream as
+    ``make_higgs_like``, so streamed and in-memory variants share the
+    labeling FUNCTION, though not the row values: the per-block RNG
+    streams necessarily differ from the single-stream draw.
+    """
+    w = np.random.default_rng(987654321).normal(0, 1, num_features)
+    n_blocks = (n + block_rows - 1) // block_rows
+    for b in range(n_blocks):
+        nb = min(block_rows, n - b * block_rows)
+        rng = np.random.default_rng((seed, b))
+        X = rng.normal(0, 1, (nb, num_features)).astype(np.float32)
+        logits = (X @ w) * 0.6 + 0.8 * np.sin(X[:, 0] * 2) * X[:, 1] \
+            + 0.5 * (X[:, 2] ** 2 - 1)
+        p = 1 / (1 + np.exp(-logits))
+        y = (rng.random(nb) < p).astype(np.float32)
+        yield X, y
+
+
 def make_boosting_curve(n: int = 1000, seed: int = 8657):
     """bagging_boosting.ipynb:67-74 faithful port (numpy legacy RandomState
     to honor np.random.seed(8657) semantics)."""

@@ -30,7 +30,8 @@ fi
 
 # 2. graftlint: AST rules + baseline + VMEM estimates + comm byte AND
 #    comm TIME budgets (r10: the pipelined merge must keep >=60% of the
-#    ring hidden behind split-scan compute at the D=8/F=136 reference)
+#    ring hidden; r11 adds the PCIe stream-prefetch budget at the same
+#    60% floor — the host->HBM transfer must hide behind hist compute)
 echo "== graftlint =="
 JAX_PLATFORMS=cpu python -m lightgbm_tpu lint
 
@@ -41,7 +42,14 @@ echo "== merge-mode parity (virtual 8-device mesh) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_merge_modes.py -q \
   -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
-# 4. trace-level budgets (slow lane)
+# 4. out-of-core streaming parity (r11: streamed-vs-in-memory trees must
+#    compare np.array_equal — strict + wave growers, ragged tails, GOSS
+#    byte accounting, scope guards)
+echo "== streaming parity =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_streaming.py -q \
+  -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# 5. trace-level budgets (slow lane)
 if [ "$full" = 1 ]; then
   echo "== budgets + recompile sweeps =="
   JAX_PLATFORMS=cpu python -m lightgbm_tpu lint --budgets -q
