@@ -32,6 +32,18 @@ queue deadline), ``show_stats=true`` (serving counters as JSON on stderr
 at shutdown), ``max_bucket``/``max_cache_entries`` (runtime knobs),
 ``warm_buckets=true`` (precompile the bucket ladder before the first
 request so no size class pays its compile on live traffic).
+
+r12 resilience keys (validated at startup; unknown keys are rejected):
+``max_queue_depth`` (admission-control bound on live queued requests;
+default ``none`` = unbounded), ``shed_policy=off|depth|deadline``
+(default ``deadline``: reject requests predicted to miss their deadline
+with a typed ``Overloaded`` error instead of letting p99 blow out),
+``canary_rows`` (post-swap canary batch size, default 8),
+``compile_cache_dir`` (jax persistent compilation cache, so restarts
+serve warm).  The model is ModelBank-backed: ``!swap <model.npz>`` /
+``!rollback`` / ``!stats`` request lines are control commands (acks on
+stderr), and SIGTERM drains gracefully — stop admitting, flush
+in-flight, final stats snapshot on stderr.
 """
 
 from __future__ import annotations
@@ -175,6 +187,9 @@ def _parse_request_line(line: str) -> Optional[np.ndarray]:
          for c in line.split(",")], dtype=np.float64)
 
 
+_SERVE_MODEL = "default"        # single-tenant CLI name in the ModelBank
+
+
 def _serve(input_model: str, cfg: Dict[str, str],
            stdin=None, stdout=None, stderr=None) -> int:
     """Micro-batched stdin/stdout serving loop (no network dependency).
@@ -182,10 +197,21 @@ def _serve(input_model: str, cfg: Dict[str, str],
     Reads one request per line, coalesces through MicroBatcher, answers
     in submission order.  Separated from main() with injectable streams
     so the loop is Tier-1-testable in-process.
+
+    The model lives in a ModelBank, so lines starting with ``!`` are
+    control commands (acks on stderr, so the prediction stream stays
+    clean): ``!swap <model.npz>`` hot-swaps to a new artifact
+    (validate -> warm -> canary -> atomic flip; a rejected swap leaves
+    the current version serving), ``!rollback`` flips back to the
+    previous resident version, ``!stats`` prints a stats snapshot.
+
+    SIGTERM drains gracefully: stop admitting, flush in-flight requests,
+    emit a final stats snapshot on stderr.
     """
     import json
+    import signal
 
-    from .serving import MicroBatcher, PackedForest, PredictorRuntime
+    from .serving import SHED_POLICIES, ModelBank, SwapRejected
     from .serving.packed import pack_booster
 
     stdin = sys.stdin if stdin is None else stdin
@@ -194,6 +220,9 @@ def _serve(input_model: str, cfg: Dict[str, str],
 
     def flag(key: str, default: bool = False) -> bool:
         return cfg.pop(key, str(default)).lower() in ("true", "1", "yes")
+
+    def die(msg: str) -> "SystemExit":
+        return SystemExit(f"task=serve: {msg}")
 
     max_batch = int(cfg.pop("max_batch", "128"))
     max_delay_ms = float(cfg.pop("max_delay_ms", "2"))
@@ -207,25 +236,58 @@ def _serve(input_model: str, cfg: Dict[str, str],
     timeout_ms = None if tmo is None else float(tmo)
     num_it = cfg.pop("num_iteration", None)
     num_iteration = None if num_it is None else int(num_it)
+    # -- r12 resilience knobs, validated up front (a typo'd operating
+    # -- point must fail the process at startup, not at 3am under load)
+    depth_s = cfg.pop("max_queue_depth", "none").lower()
+    try:
+        max_queue_depth = None if depth_s in ("none", "") else int(depth_s)
+    except ValueError:
+        raise die(f"max_queue_depth must be an integer or 'none', "
+                  f"got {depth_s!r}") from None
+    if max_queue_depth is not None and max_queue_depth < 1:
+        raise die(f"max_queue_depth must be >= 1, got {max_queue_depth}")
+    shed_policy = cfg.pop("shed_policy", "deadline")
+    if shed_policy not in SHED_POLICIES:
+        raise die(f"shed_policy must be one of {'|'.join(SHED_POLICIES)},"
+                  f" got {shed_policy!r}")
+    try:
+        canary_rows = int(cfg.pop("canary_rows", "8"))
+    except ValueError:
+        raise die("canary_rows must be an integer") from None
+    if canary_rows < 0:
+        raise die(f"canary_rows must be >= 0, got {canary_rows}")
+    cache_dir = cfg.pop("compile_cache_dir", None)
+    if cfg:
+        raise die(f"unknown key(s): {', '.join(sorted(cfg))}")
 
-    if input_model.endswith(".npz"):
-        packed = PackedForest.load(input_model)   # validates on ingest
-    else:
+    bank = ModelBank(max_bucket=max_bucket, max_cache_entries=max_cache,
+                     warm_on_deploy=warm_buckets, canary_rows=canary_rows,
+                     cache_dir=cache_dir)
+
+    def deploy(path: str) -> dict:
+        if path.endswith(".npz"):
+            return bank.deploy(_SERVE_MODEL, path, raw_score=raw_score)
         import lightgbm_tpu as lgb
 
-        packed = pack_booster(lgb.Booster(model_file=input_model))
-    runtime = PredictorRuntime(packed, max_bucket=max_bucket,
-                               max_cache_entries=max_cache)
+        packed = pack_booster(lgb.Booster(model_file=path))
+        return bank.deploy(_SERVE_MODEL, packed, raw_score=raw_score)
+
+    try:
+        rep = deploy(input_model)
+    except SwapRejected as e:
+        raise die(f"input_model rejected: {e}") from None
     if warm_buckets:
-        # precompile the bucket ladder before reading any request, so
-        # the first batch of each size class pays dispatch, not compile
-        n_warmed = runtime.warm(raw_score=raw_score)
-        stderr.write(f"[lightgbm_tpu] warmed {n_warmed} bucket "
+        # the ladder precompiled inside deploy(), before the first
+        # request — each size class pays dispatch, not compile
+        stderr.write(f"[lightgbm_tpu] warmed {rep['warmed']} bucket "
                      f"programs\n")
         stderr.flush()
-    batcher = MicroBatcher(runtime, max_batch=max_batch,
+    batcher = bank.batcher(_SERVE_MODEL, max_batch=max_batch,
                            max_delay_ms=max_delay_ms,
-                           timeout_ms=timeout_ms, raw_score=raw_score)
+                           timeout_ms=timeout_ms, raw_score=raw_score,
+                           max_queue_depth=max_queue_depth,
+                           shed_policy=shed_policy)
+    stats = batcher.stats
 
     def emit(pending) -> None:
         try:
@@ -240,26 +302,74 @@ def _serve(input_model: str, cfg: Dict[str, str],
         else:
             stdout.write(",".join(f"{x:.10g}" for x in v) + "\n")
 
-    pendings = []
-    for line in stdin:
+    def control(line: str) -> None:
+        parts = line[1:].split()
+        cmd = parts[0] if parts else ""
         try:
-            row = _parse_request_line(line)
-        except (ValueError, json.JSONDecodeError) as e:
-            pendings.append(_failed_pending(e))
-            continue
-        if row is None:
-            continue
-        pendings.append(batcher.submit(row, num_iteration=num_iteration))
-        batcher.pump()
-        # stream out everything already resolved, in order
-        while pendings and pendings[0].done:
-            emit(pendings.pop(0))
-    batcher.flush()
-    for p in pendings:
-        emit(p)
-    stdout.flush()
-    if show_stats:
-        stderr.write(json.dumps(runtime.stats.snapshot()) + "\n")
+            if cmd == "swap" and len(parts) == 2:
+                r = deploy(parts[1])
+                stderr.write(f"[lightgbm_tpu] swapped {_SERVE_MODEL} -> "
+                             f"{r['version']}\n")
+            elif cmd == "rollback":
+                r = bank.rollback(_SERVE_MODEL)
+                stderr.write(f"[lightgbm_tpu] rolled back {_SERVE_MODEL} "
+                             f"-> {r['version']}\n")
+            elif cmd == "stats":
+                stderr.write(json.dumps(stats.snapshot()) + "\n")
+            else:
+                stderr.write(f"[lightgbm_tpu] unknown control "
+                             f"{line.strip()!r} (!swap <path> | "
+                             f"!rollback | !stats)\n")
+        except SwapRejected as e:
+            # the old version never stopped serving
+            stderr.write(f"[lightgbm_tpu] {e}\n")
+        stderr.flush()
+
+    draining = False
+
+    def _on_term(signum, frame):                   # noqa: ARG001
+        nonlocal draining
+        draining = True
+
+    try:
+        prev_handler = signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:                             # not the main thread
+        prev_handler = None
+
+    pendings = []
+    try:
+        for line in stdin:
+            if draining:
+                break                              # stop admitting
+            if line.lstrip().startswith("!"):
+                control(line)
+                continue
+            try:
+                row = _parse_request_line(line)
+            except (ValueError, json.JSONDecodeError) as e:
+                pendings.append(_failed_pending(e))
+                continue
+            if row is None:
+                continue
+            pendings.append(batcher.submit(row,
+                                           num_iteration=num_iteration))
+            batcher.pump()
+            # stream out everything already resolved, in order
+            while pendings and pendings[0].done:
+                emit(pendings.pop(0))
+        # graceful drain (SIGTERM or EOF): flush in-flight, answer all
+        batcher.flush()
+        for p in pendings:
+            emit(p)
+        stdout.flush()
+    finally:
+        if prev_handler is not None:
+            signal.signal(signal.SIGTERM, prev_handler)
+    if draining:
+        stderr.write(f"[lightgbm_tpu] drained on SIGTERM "
+                     f"({len(pendings)} in-flight flushed)\n")
+    if show_stats or draining:
+        stderr.write(json.dumps(stats.snapshot()) + "\n")
         stderr.flush()
     return 0
 

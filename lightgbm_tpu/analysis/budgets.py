@@ -845,3 +845,194 @@ def check_stream_budgets(names: Optional[List[str]] = None
     specs = (STREAM_TIME_BUDGETS if names is None
              else [stream_budget_by_name(n) for n in names])
     return [b.check() for b in specs]
+
+
+# ---------------------------------------------------------------------------
+# Serving SLO budgets (r12): shed-before-miss + bounded fault inflation
+# ---------------------------------------------------------------------------
+# Pure arithmetic (fluid-limit queue model, no devices) so these run in
+# the default ``lint`` pass like the comm/stream models above.  The same
+# model is what the MicroBatcher's admission control implements online
+# with an EWMA of measured dispatch time (queue.predicted_wait_s), and
+# what tools/bench_loadgen.py replays against measured saturation runs —
+# one model, three consumers.
+#
+# Fluid view of the micro-batched server: capacity is
+# ``max_batch / dispatch_s`` rows/s (saturated batches are full).  With
+# utilization <= 1 the queue is stable and waits are the coalescing
+# delay plus one dispatch.  Past saturation the two policies diverge:
+#
+# * admission OFF — the queue grows without bound; once the backlog's
+#   drain time passes the deadline EVERY admitted request expires in
+#   queue, so the steady-state deadline-miss fraction -> 1.  p99 is
+#   unbounded (grows with time in saturation).
+# * admission ON (``deadline`` policy) — submit-time shedding holds the
+#   backlog where predicted wait == deadline, so served requests wait at
+#   most one deadline by construction: miss fraction -> 0, shed fraction
+#   -> 1 - 1/utilization, and throughput stays at capacity.
+#
+# That asymmetry IS the r12 invariant: rejections are cheap and typed
+# (``Overloaded`` at submit), deadline misses burn a dispatch slot to
+# serve nobody.  "Shed before miss."
+
+
+def serve_queue_model(arrival_rps: float, dispatch_ms: float,
+                      max_batch: int = 128, max_delay_ms: float = 5.0,
+                      deadline_ms: float = 50.0,
+                      shed_policy: str = "deadline"
+                      ) -> Dict[str, float]:
+    """Steady-state miss/shed fractions for a micro-batched server.
+
+    Returns ``utilization``, ``served_frac``, ``shed_frac``,
+    ``miss_frac`` and ``wait_ms`` (queue wait of a served request) under
+    the fluid model above.  ``shed_policy`` is "off" or "deadline"
+    (matching ``serving.queue.SHED_POLICIES``; "depth" behaves like
+    "deadline" here when the depth bound is tuned to the deadline).
+    """
+    dispatch_s = dispatch_ms / 1e3
+    deadline_s = deadline_ms / 1e3
+    capacity_rps = max_batch / dispatch_s if dispatch_s > 0 else \
+        float("inf")
+    util = arrival_rps / capacity_rps if capacity_rps > 0 else \
+        float("inf")
+    if util <= 1.0:
+        # stable: wait = batch fill time (capped by the delay bound) + 1
+        # dispatch
+        fill_s = (min(max_delay_ms / 1e3, max_batch / arrival_rps)
+                  if arrival_rps > 0 else 0.0)
+        wait_s = fill_s + dispatch_s
+        miss = 0.0 if wait_s <= deadline_s else 1.0
+        return {"utilization": util, "served_frac": 1.0 - miss,
+                "shed_frac": 0.0, "miss_frac": miss,
+                "wait_ms": wait_s * 1e3}
+    if shed_policy == "off":
+        # unbounded backlog: every admitted request eventually waits past
+        # the deadline -> steady-state miss fraction 1, and the server
+        # burns dispatches on rows nobody is waiting for
+        return {"utilization": util, "served_frac": 0.0,
+                "shed_frac": 0.0, "miss_frac": 1.0,
+                "wait_ms": float("inf")}
+    # admission control pins the backlog at predicted wait == deadline:
+    # excess arrivals shed at submit, served requests ride a full queue
+    served = 1.0 / util
+    return {"utilization": util, "served_frac": served,
+            "shed_frac": 1.0 - served, "miss_frac": 0.0,
+            "wait_ms": deadline_ms}
+
+
+def serve_fault_p99_model(deadline_ms: float = 50.0,
+                          dispatch_ms: float = 2.0,
+                          max_delay_ms: float = 5.0,
+                          shedding: bool = True) -> Dict[str, float]:
+    """p99 inflation under ONE injected device fault mid-predict.
+
+    Clean p99 is the coalescing delay plus one dispatch.  A fault stalls
+    the pipeline (the faulted batch retries through the numpy fallback)
+    and the backlog it leaves behind inflates tail latency.  With
+    admission control the damage is CAPPED: requests whose predicted
+    wait passes the deadline shed at submit, so no served request waits
+    longer than ``deadline + dispatch`` — the fault p99 is bounded by
+    the SLO itself, not by the stall length.  Without shedding the
+    backlog drains at the server's leisure and the tail is open-ended
+    (modeled here as one full deadline of backlog ON TOP of the stall).
+    """
+    clean_p99 = max_delay_ms + dispatch_ms
+    if shedding:
+        fault_p99 = deadline_ms + dispatch_ms
+    else:
+        fault_p99 = deadline_ms + clean_p99 + deadline_ms
+    return {"clean_p99_ms": clean_p99, "fault_p99_ms": fault_p99,
+            "inflation_x": fault_p99 / clean_p99 if clean_p99 > 0
+            else float("inf")}
+
+
+@dataclass(frozen=True)
+class ServeSLOBudget:
+    """One serving SLO invariant at a reference operating point.
+
+    ``kind`` selects the measurement:
+
+    * ``queue_miss`` — deadline-miss fraction at ``utilization_x``
+      overload with admission control ON (the shed-before-miss bar:
+      <= 1%);
+    * ``queue_miss_off`` — the same point with admission OFF; budgeted
+      from BELOW (miss ~ 1.0) so the model provably separates the
+      policies — a "budget" that guards the model, not the code;
+    * ``served_frac`` — throughput retained under overload with
+      shedding (floor: ~1/utilization);
+    * ``fault_inflation`` — p99 inflation under one injected device
+      fault with shedding active (ceiling).
+
+    ``cmp`` is "le" (measured <= budget passes) or "ge".
+    Reference point: 2 ms dispatches, 128-row batches, 5 ms coalescing
+    delay, 50 ms deadlines — the bench_loadgen defaults.
+    """
+
+    name: str
+    kind: str
+    budget: float
+    cmp: str = "le"
+    utilization_x: float = 2.0
+    dispatch_ms: float = 2.0
+    max_batch: int = 128
+    max_delay_ms: float = 5.0
+    deadline_ms: float = 50.0
+    note: str = ""
+
+    def measure(self) -> float:
+        cap_rps = self.max_batch / (self.dispatch_ms / 1e3)
+        arrival = self.utilization_x * cap_rps
+        if self.kind in ("queue_miss", "queue_miss_off", "served_frac"):
+            m = serve_queue_model(
+                arrival, self.dispatch_ms, self.max_batch,
+                self.max_delay_ms, self.deadline_ms,
+                shed_policy=("off" if self.kind == "queue_miss_off"
+                             else "deadline"))
+            return m["served_frac"] if self.kind == "served_frac" \
+                else m["miss_frac"]
+        if self.kind == "fault_inflation":
+            return serve_fault_p99_model(
+                self.deadline_ms, self.dispatch_ms,
+                self.max_delay_ms, shedding=True)["inflation_x"]
+        raise ValueError(f"unknown SLO budget kind {self.kind!r}")
+
+    def check(self) -> Dict[str, object]:
+        measured = self.measure()
+        ok = (measured <= self.budget if self.cmp == "le"
+              else measured >= self.budget)
+        return {"name": self.name, "kind": self.kind,
+                "measured": round(measured, 4), "budget": self.budget,
+                "cmp": self.cmp, "ok": ok, "note": self.note}
+
+
+SERVE_SLO_BUDGETS: Tuple[ServeSLOBudget, ...] = (
+    ServeSLOBudget("serve_shed_before_miss", "queue_miss", 0.01,
+                   note="r12 acceptance: <=1% deadline misses at 2x "
+                        "overload with admission control on"),
+    ServeSLOBudget("serve_miss_without_admission", "queue_miss_off",
+                   0.99, cmp="ge",
+                   note="counterfactual: admission off at 2x overload "
+                        "misses ~everything — the model separates the "
+                        "policies"),
+    ServeSLOBudget("serve_capacity_under_shed", "served_frac", 0.45,
+                   cmp="ge",
+                   note="shedding keeps throughput at capacity: "
+                        ">=45% of a 2x-overload arrival stream served"),
+    ServeSLOBudget("serve_fault_p99_inflation", "fault_inflation", 8.0,
+                   note="one device fault inflates p99 <=8x (capped at "
+                        "deadline+dispatch by shed-before-miss)"),
+)
+
+
+def serve_slo_budget_by_name(name: str) -> ServeSLOBudget:
+    for b in SERVE_SLO_BUDGETS:
+        if b.name == name:
+            return b
+    raise KeyError(name)
+
+
+def check_serve_slo_budgets(names: Optional[List[str]] = None
+                            ) -> List[Dict[str, object]]:
+    specs = (SERVE_SLO_BUDGETS if names is None
+             else [serve_slo_budget_by_name(n) for n in names])
+    return [b.check() for b in specs]

@@ -14,26 +14,49 @@ drives it at high throughput:
     batcher = MicroBatcher(rt, max_batch=256, max_delay_ms=2.0)
     handle = batcher.submit(row); batcher.pump(); handle.result()
 
+Multi-model tenancy and resilience ride on top:
+
+    bank = ModelBank(warm_on_deploy=True, cache_dir=".jaxcache")
+    bank.deploy("fraud", "model_v1.npz")      # validate -> warm -> canary -> flip
+    mb = bank.batcher("fraud", max_queue_depth=512)   # sheds with Overloaded
+    bank.deploy("fraud", "model_v2.npz")      # zero-downtime hot swap
+    bank.rollback("fraud")                    # instant, bit-identical
+
 See packed.py (format + ingest validation), runtime.py (shape-bucketed
-compile cache), queue.py (micro-batching), stats.py (counters).  The CLI
-front end is ``python -m lightgbm_tpu task=serve input_model=...``.
+compile cache), queue.py (micro-batching + admission control), bank.py
+(tenancy/hot swap/rollback), faults.py (deterministic fault injection),
+stats.py (counters).  The CLI front end is ``python -m lightgbm_tpu
+task=serve input_model=...``.
 """
 
+from .bank import ModelBank, SwapRejected
+from .faults import SITES as FAULT_SITES
+from .faults import FaultError, FaultInjector, FaultSpec
 from .packed import (PACKED_FORMAT_VERSION, PackedForest, PackedForestError,
                      pack_booster)
-from .queue import MicroBatcher, PendingPrediction, RequestTimeout
-from .runtime import PredictorRuntime, bucket_for
+from .queue import (SHED_POLICIES, MicroBatcher, Overloaded,
+                    PendingPrediction, RequestTimeout)
+from .runtime import PredictorRuntime, bucket_for, enable_persistent_cache
 from .stats import ServingStats
 
 __all__ = [
+    "FAULT_SITES",
+    "FaultError",
+    "FaultInjector",
+    "FaultSpec",
     "MicroBatcher",
+    "ModelBank",
+    "Overloaded",
     "PACKED_FORMAT_VERSION",
     "PackedForest",
     "PackedForestError",
     "PendingPrediction",
     "PredictorRuntime",
     "RequestTimeout",
+    "SHED_POLICIES",
     "ServingStats",
+    "SwapRejected",
     "bucket_for",
+    "enable_persistent_cache",
     "pack_booster",
 ]

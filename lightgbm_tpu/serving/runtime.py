@@ -44,6 +44,31 @@ def bucket_for(n: int, max_bucket: int) -> int:
     return min(1 << (int(n - 1).bit_length()), max_bucket)
 
 
+def enable_persistent_cache(cache_dir: str) -> bool:
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    Best-effort: returns True when the config landed, False when this
+    jax build has no persistent cache (the warm-manifest path still
+    works — restarts then pay compiles, not correctness).  Thresholds
+    are zeroed so even the small bucket programs are cached; a restarted
+    process that re-warms the same ladder then deserializes executables
+    instead of recompiling them.
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    except Exception:                          # noqa: BLE001
+        return False
+    for key, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                     ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(key, val)
+        except Exception:                      # noqa: BLE001
+            pass                               # older jax: defaults apply
+    return True
+
+
 class PredictorRuntime:
     """Serve a packed forest at fixed shapes with a bounded compile cache.
 
@@ -55,13 +80,17 @@ class PredictorRuntime:
         drops the jitted callable, so a re-used evicted bucket recompiles.
       donate: donate the padded input buffer to XLA; default on for TPU
         backends only (CPU donation is a no-op that warns).
+      faults: optional serving.faults.FaultInjector consulted at the
+        ``device_predict`` site before every compiled dispatch — the
+        deterministic stand-in for a device error mid-predict.
     """
 
     def __init__(self, packed: PackedForest,
                  max_bucket: int = DEFAULT_MAX_BUCKET,
                  max_cache_entries: int = DEFAULT_CACHE_ENTRIES,
                  donate: Optional[bool] = None,
-                 stats: Optional[ServingStats] = None):
+                 stats: Optional[ServingStats] = None,
+                 faults=None):
         import jax
 
         if max_bucket < 1 or (max_bucket & (max_bucket - 1)):
@@ -71,6 +100,7 @@ class PredictorRuntime:
         self.max_bucket = int(max_bucket)
         self.max_cache_entries = int(max_cache_entries)
         self.stats = stats if stats is not None else ServingStats()
+        self.faults = faults
         self._donate = (jax.default_backend() == "tpu"
                         if donate is None else bool(donate))
         self._forest = packed.to_tree()           # device-resident once
@@ -157,6 +187,8 @@ class PredictorRuntime:
                   raw_score: bool) -> np.ndarray:
         import jax.numpy as jnp
 
+        if self.faults is not None:
+            self.faults.check("device_predict")   # may raise FaultError
         t0 = time.perf_counter()
         n = codes.shape[0]
         bucket = bucket_for(n, self.max_bucket)

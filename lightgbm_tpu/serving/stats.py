@@ -12,6 +12,7 @@ cadence without unbounded memory.
 
 from __future__ import annotations
 
+import threading
 from collections import deque
 from typing import Dict, Optional
 
@@ -59,14 +60,18 @@ def _ms(v: Optional[float]) -> Optional[float]:
 
 class ServingStats:
     """Aggregates serving counters; all methods are cheap and allocation-
-    light (hot-path safe).  Not thread-safe by itself — the micro-batch
-    queue serializes writers."""
+    light (hot-path safe).  Safe under concurrent writers: every mutation
+    and the snapshot hold one internal lock, so the load generator's and
+    the drain path's snapshots are consistent even when the runtime, the
+    queue, and a stats poller live on different threads."""
 
     def __init__(self):
+        self._lock = threading.Lock()
         self._buckets: Dict[int, _BucketStats] = {}
         self.requests = 0            # queue-level submitted requests
         self.batched_dispatches = 0  # queue-level coalesced dispatches
         self.timeouts = 0            # requests expired before dispatch
+        self.sheds = 0               # admission-control Overloaded rejects
         self.fallbacks = 0           # graceful-degradation CPU predicts
         self.queue_latencies = deque(maxlen=RESERVOIR)
         self._cache_info = None      # zero-arg callable set by the runtime
@@ -75,8 +80,11 @@ class ServingStats:
         """Register a zero-arg callable returning compile-cache counters;
         its dict lands under ``compile_cache`` in every snapshot (keeps
         this module free of the runtime while the serve CLI still prints
-        ONE shutdown dict)."""
-        self._cache_info = provider
+        ONE shutdown dict).  A hot swap re-attaches the new runtime's
+        provider to the same ServingStats, so per-model counters persist
+        across versions while the cache view tracks the active one."""
+        with self._lock:
+            self._cache_info = provider
 
     def _b(self, bucket: int) -> _BucketStats:
         bs = self._buckets.get(bucket)
@@ -87,47 +95,62 @@ class ServingStats:
     # -- runtime-side ------------------------------------------------------
     def record_dispatch(self, bucket: int, rows: int, padded: int,
                         latency_s: float) -> None:
-        bs = self._b(bucket)
-        bs.rows += rows
-        bs.dispatches += 1
-        bs.padded_rows += padded
-        bs.latencies.append(latency_s)
+        with self._lock:
+            bs = self._b(bucket)
+            bs.rows += rows
+            bs.dispatches += 1
+            bs.padded_rows += padded
+            bs.latencies.append(latency_s)
 
     def record_cache(self, bucket: int, hit: bool) -> None:
-        bs = self._b(bucket)
-        if hit:
-            bs.cache_hits += 1
-        else:
-            bs.cache_misses += 1
+        with self._lock:
+            bs = self._b(bucket)
+            if hit:
+                bs.cache_hits += 1
+            else:
+                bs.cache_misses += 1
 
     # -- queue-side --------------------------------------------------------
     def record_request(self, n: int = 1) -> None:
-        self.requests += n
+        with self._lock:
+            self.requests += n
 
     def record_batch(self, queue_latency_s: float) -> None:
-        self.batched_dispatches += 1
-        self.queue_latencies.append(queue_latency_s)
+        with self._lock:
+            self.batched_dispatches += 1
+            self.queue_latencies.append(queue_latency_s)
 
     def record_timeout(self, n: int = 1) -> None:
-        self.timeouts += n
+        with self._lock:
+            self.timeouts += n
+
+    def record_shed(self, n: int = 1) -> None:
+        with self._lock:
+            self.sheds += n
 
     def record_fallback(self, n: int = 1) -> None:
-        self.fallbacks += n
+        with self._lock:
+            self.fallbacks += n
 
     # -- reporting ---------------------------------------------------------
     def snapshot(self) -> dict:
-        out = {
-            "requests": self.requests,
-            "batched_dispatches": self.batched_dispatches,
-            "timeouts": self.timeouts,
-            "fallbacks": self.fallbacks,
-            "queue_latency_p50_ms": _ms(_quantile(self.queue_latencies,
-                                                  0.50)),
-            "queue_latency_p99_ms": _ms(_quantile(self.queue_latencies,
-                                                  0.99)),
-            "buckets": [self._buckets[b].snapshot(b)
-                        for b in sorted(self._buckets)],
-        }
-        if self._cache_info is not None:
-            out["compile_cache"] = self._cache_info()
+        with self._lock:
+            out = {
+                "requests": self.requests,
+                "batched_dispatches": self.batched_dispatches,
+                "timeouts": self.timeouts,
+                "sheds": self.sheds,
+                "fallbacks": self.fallbacks,
+                "queue_latency_p50_ms": _ms(_quantile(self.queue_latencies,
+                                                      0.50)),
+                "queue_latency_p99_ms": _ms(_quantile(self.queue_latencies,
+                                                      0.99)),
+                "buckets": [self._buckets[b].snapshot(b)
+                            for b in sorted(self._buckets)],
+            }
+            provider = self._cache_info
+        # outside the lock: the provider reads runtime-side counters and
+        # must not nest under ours
+        if provider is not None:
+            out["compile_cache"] = provider()
         return out

@@ -1,0 +1,528 @@
+"""Serving load generator: saturation, shedding and fault scenarios.
+
+Drives the resilient serving stack (ModelBank + admission-controlled
+MicroBatcher) through open- and closed-loop request streams, mixed batch
+sizes and deterministic fault injections, and records p50/p99/p99.9
+latency, deadline-miss rate and shed rate into ``BENCH_SERVE_r12.json``
+together with the ``acceptance_r12`` rollup the r12 issue gates on:
+
+* closed-loop saturation with ONE injected device fault keeps the
+  deadline-miss rate <= 1% while shedding is active (shed before miss);
+* a hot swap under load flips with ZERO failed in-flight requests;
+* rollback (after corrupt-artifact swap rejections) restores the prior
+  version bit-identically.
+
+Queueing dynamics run on a SIM CLOCK for determinism: the batcher, its
+deadlines and its EWMA wait predictor all read an advancing virtual
+clock, and every device dispatch charges the CALIBRATED median dispatch
+time into it (calibrated per host with real ``perf_counter`` timings, so
+the operating point is honest; charging the median instead of each
+dispatch's jitter keeps the shed/miss accounting reproducible).  Real
+wall-clock dispatch latencies are reported separately by the mixed-size
+direct scenario.
+
+A deadline MISS counts both requests that expired in queue
+(``RequestTimeout`` — the queue's own counter) and requests served after
+their deadline passed; a SHED is a typed ``Overloaded`` rejection at
+submit.  The r12 invariant is that under overload the stack sheds, and
+what it admits, it serves on time.
+
+Usage: python tools/bench_loadgen.py [n_trees] [out.json]
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.analysis.budgets import (check_serve_slo_budgets,
+                                           serve_queue_model)
+from lightgbm_tpu.serving import (FaultInjector, MicroBatcher, ModelBank,
+                                  Overloaded, RequestTimeout, SwapRejected,
+                                  pack_booster)
+
+MAX_BATCH = 64
+MAX_BUCKET = 256
+EPS = 1e-9
+
+
+class SimClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += max(float(dt), 0.0)
+
+
+class TimedRuntime:
+    """Runtime proxy that charges the calibrated dispatch cost into the
+    sim clock on every predict (success OR injected fault — the faulted
+    dispatch still burned its slot)."""
+
+    def __init__(self, rt, clock: SimClock, charge_s: float):
+        self._rt = rt
+        self.clock = clock
+        self.charge_s = charge_s
+        self.packed = rt.packed
+        self.stats = rt.stats
+
+    def predict(self, X, **kw):
+        try:
+            return self._rt.predict(X, **kw)
+        finally:
+            self.clock.advance(self.charge_s)
+
+
+def build_model(n_trees: int):
+    rng = np.random.default_rng(0)
+    n, f = 8_000, 8
+    X = rng.normal(size=(n, f))
+    y = (2.0 * X[:, 0] + np.sin(3 * X[:, 1]) + 0.5 * X[:, 2] * X[:, 3]
+         + 0.1 * rng.normal(size=n))
+    booster = lgb.train(
+        {"objective": "regression", "num_leaves": 31, "verbosity": -1},
+        lgb.Dataset(X, label=y), num_boost_round=n_trees)
+    return booster, X
+
+
+def quantiles(vals):
+    if not vals:
+        return {"p50_ms": None, "p99_ms": None, "p999_ms": None}
+    s = np.sort(np.asarray(vals, np.float64))
+
+    def q(p):
+        return float(s[min(len(s) - 1, int(round(p * (len(s) - 1))))])
+
+    return {"p50_ms": q(0.50) * 1e3, "p99_ms": q(0.99) * 1e3,
+            "p999_ms": q(0.999) * 1e3}
+
+
+class Recorder:
+    def __init__(self):
+        self.latencies = []          # served requests, sim seconds
+        self.ok = 0
+        self.sheds = 0
+        self.expired = 0
+        self.late = 0
+        self.errors = 0
+
+    def settle(self, handle, t_submit, t_done, deadline) -> None:
+        try:
+            handle.result()
+        except Overloaded:
+            self.sheds += 1
+            return
+        except RequestTimeout:
+            self.expired += 1
+            return
+        except Exception:                            # noqa: BLE001
+            self.errors += 1
+            return
+        self.ok += 1
+        self.latencies.append(t_done - t_submit)
+        if deadline is not None and t_done > deadline + EPS:
+            self.late += 1
+
+    def summary(self) -> dict:
+        total = self.ok + self.sheds + self.expired + self.errors
+        admitted = self.ok + self.expired + self.errors
+        misses = self.expired + self.late
+        return {
+            "requests": total,
+            "served": self.ok,
+            "sheds": self.sheds,
+            "expired_in_queue": self.expired,
+            "served_late": self.late,
+            "deadline_misses": misses,
+            "errors": self.errors,
+            "shed_rate": self.sheds / total if total else 0.0,
+            "miss_rate": misses / admitted if admitted else 0.0,
+            **quantiles(self.latencies),
+        }
+
+
+def run_closed_loop(batcher, clock: SimClock, rows, n_requests: int,
+                    concurrency: int, deadline_ms: float) -> Recorder:
+    """Closed loop: keep up to ``concurrency`` admitted requests
+    outstanding until ``n_requests`` have been submitted, then drain.
+    Under overload the admission controller, not ``concurrency``, is
+    what bounds the queue — excess submissions shed instantly."""
+    rec = Recorder()
+    inflight = []                     # (handle, t_submit, deadline)
+    submitted = 0
+    deadline_s = deadline_ms / 1e3
+    while submitted < n_requests or inflight:
+        while submitted < n_requests and len(inflight) < concurrency:
+            t = clock()
+            h = batcher.submit(rows[submitted % len(rows)],
+                               timeout_ms=deadline_ms)
+            submitted += 1
+            if h.done:                # shed at submit
+                rec.settle(h, t, clock(), t + deadline_s)
+            else:
+                inflight.append((h, t, t + deadline_s))
+        before = len(inflight)
+        batcher.pump()
+        still = []
+        for h, t, d in inflight:
+            if h.done:
+                rec.settle(h, t, clock(), d)
+            else:
+                still.append((h, t, d))
+        inflight = still
+        if inflight and len(inflight) == before:
+            # short batch waiting out the coalescing delay
+            clock.advance(batcher.max_delay_s)
+    batcher.flush()
+    return rec
+
+
+def run_open_loop(batcher, clock: SimClock, rows, n_requests: int,
+                  rps: float, deadline_ms: float) -> Recorder:
+    """Open loop: fixed-rate arrivals at ``rps`` in sim time
+    (deterministic interarrival), pumped after every arrival."""
+    rec = Recorder()
+    inflight = []
+    gap = 1.0 / rps
+    deadline_s = deadline_ms / 1e3
+
+    def drain_done():
+        still = []
+        for h, t, d in inflight:
+            if h.done:
+                rec.settle(h, t, clock(), d)
+            else:
+                still.append((h, t, d))
+        inflight[:] = still
+
+    for i in range(n_requests):
+        clock.advance(gap)
+        t = clock()
+        h = batcher.submit(rows[i % len(rows)], timeout_ms=deadline_ms)
+        if h.done:
+            rec.settle(h, t, clock(), t + deadline_s)
+        else:
+            inflight.append((h, t, t + deadline_s))
+        batcher.pump()
+        drain_done()
+    clock.advance(batcher.max_delay_s)
+    batcher.pump()
+    batcher.flush()
+    drain_done()
+    for h, t, d in inflight:
+        rec.settle(h, t, clock(), d)
+    return rec
+
+
+def make_batcher(bank, name, clock, deadline_ms, charge_ms, policy):
+    charge_s = charge_ms / 1e3
+    cache = {}
+
+    def provider():
+        rt = bank.runtime(name)       # hot swaps land here per dispatch
+        if rt not in cache:
+            cache[rt] = TimedRuntime(rt, clock, charge_s)
+        return cache[rt]
+
+    return MicroBatcher(provider, max_batch=MAX_BATCH, max_delay_ms=1.0,
+                        timeout_ms=deadline_ms, clock=clock,
+                        max_queue_depth=64 * MAX_BATCH,
+                        shed_policy=policy, service_time_hint_ms=charge_ms)
+
+
+def calibrate(bank, name, rows) -> float:
+    """Median warm wall-clock time of one full-batch dispatch, ms."""
+    rt = bank.runtime(name)
+    X = np.stack([rows[i % len(rows)] for i in range(MAX_BATCH)])
+    ts = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        rt.predict(X)
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts)) * 1e3
+
+
+def scenario_saturation(bank, name, rows, dispatch_ms, policy,
+                        faults=None, n_requests=4000):
+    """Closed-loop overload burst against a deadline sized at ~6
+    dispatches.  With admission ON the controller admits ~6 batches and
+    sheds the rest; with admission OFF everything is admitted and most
+    of it is served past its deadline — the counterfactual."""
+    clock = SimClock()
+    deadline_ms = 6.0 * dispatch_ms
+    b = make_batcher(bank, name, clock, deadline_ms, dispatch_ms, policy)
+    fallbacks0 = b.stats.snapshot()["fallbacks"]
+    if faults is not None:
+        bank.runtime(name).faults = faults
+    try:
+        rec = run_closed_loop(b, clock, rows, n_requests,
+                              concurrency=32 * MAX_BATCH,
+                              deadline_ms=deadline_ms)
+    finally:
+        if faults is not None:
+            bank.runtime(name).faults = None
+    out = rec.summary()
+    out["deadline_ms"] = deadline_ms
+    out["shed_policy"] = policy
+    out["fallbacks"] = b.stats.snapshot()["fallbacks"] - fallbacks0
+    if out["p99_ms"] is not None:
+        out["p99_vs_deadline_x"] = round(out["p99_ms"] / deadline_ms, 3)
+    if faults is not None:
+        out["faults"] = faults.snapshot()
+    return out
+
+
+def scenario_open_underload(bank, name, rows, dispatch_ms,
+                            n_requests=2000):
+    clock = SimClock()
+    capacity_rps = MAX_BATCH / (dispatch_ms / 1e3)
+    deadline_ms = 20.0 * dispatch_ms
+    b = make_batcher(bank, name, clock, deadline_ms, dispatch_ms,
+                     "deadline")
+    rec = run_open_loop(b, clock, rows, n_requests,
+                        rps=0.5 * capacity_rps, deadline_ms=deadline_ms)
+    out = rec.summary()
+    out.update(deadline_ms=deadline_ms, utilization=0.5)
+    return out
+
+
+def scenario_mixed_direct(bank, name, rows, n_batches=150):
+    """Mixed batch sizes straight into the runtime (no queue): REAL
+    wall-clock per-dispatch latency across the bucket ladder."""
+    rng = np.random.default_rng(3)
+    rt = bank.runtime(name)
+    sizes = rng.integers(1, MAX_BUCKET + 1, size=n_batches)
+    lats = []
+    for n in sizes:
+        X = np.stack([rows[i % len(rows)] for i in range(int(n))])
+        t0 = time.perf_counter()
+        rt.predict(X)
+        lats.append(time.perf_counter() - t0)
+    return {"batches": int(n_batches), "rows": int(sizes.sum()),
+            "size_range": [1, MAX_BUCKET], "timing": "real_wall_clock",
+            **quantiles(lats)}
+
+
+def scenario_hot_swap(bank, name, rows, v2_path, dispatch_ms):
+    """Swap to v2 while a request stream is in flight; every queued
+    request must resolve (on v1 or v2 — never an error or a miss)."""
+    clock = SimClock()
+    deadline_ms = 40.0 * dispatch_ms
+    deadline_s = deadline_ms / 1e3
+    b = make_batcher(bank, name, clock, deadline_ms, dispatch_ms,
+                     "deadline")
+    rec = Recorder()
+    inflight = []
+    swap = None
+    for i in range(600):
+        t = clock()
+        h = b.submit(rows[i % len(rows)], timeout_ms=deadline_ms)
+        if h.done:
+            rec.settle(h, t, clock(), t + deadline_s)
+        else:
+            inflight.append((h, t, t + deadline_s))
+        if i == 300:
+            pending = b.pending_count()
+            rep = bank.deploy(name, v2_path, warm=False)
+            swap = {"request_index": i, "pending_at_swap": pending,
+                    "version": rep["version"],
+                    "canary": rep["canary"]}
+        b.pump()
+        still = []
+        for h, t, d in inflight:
+            if h.done:
+                rec.settle(h, t, clock(), d)
+            else:
+                still.append((h, t, d))
+        inflight = still
+        if inflight:
+            clock.advance(b.max_delay_s)
+    b.flush()
+    for h, t, d in inflight:
+        rec.settle(h, t, clock(), d)
+    out = rec.summary()
+    out["swap"] = swap
+    out["failed_inflight"] = rec.errors + rec.expired + rec.late
+    return out
+
+
+def scenario_rollback(bank, name, probe, v1_baseline, corrupt_specs):
+    """Corrupt-artifact swaps must reject at ingest with the active
+    version still serving BIT-identically, and rollback must restore
+    the original version's exact outputs."""
+    before_version = bank.version(name)
+    before = bank.predict(name, probe)
+    rejections = []
+    for label, path in corrupt_specs:
+        try:
+            bank.deploy(name, path)
+            rejections.append({"artifact": label, "rejected": False})
+        except SwapRejected as e:
+            rejections.append({"artifact": label, "rejected": True,
+                               "stage": e.stage, "error": str(e)})
+    after = bank.predict(name, probe)
+    rb = bank.rollback(name)
+    restored = bank.predict(name, probe)
+    return {
+        "active_version": before_version,
+        "rejections": rejections,
+        "all_rejected": all(r["rejected"] for r in rejections),
+        "serving_bit_identical_after_rejections":
+            bool(np.array_equal(before, after)),
+        "rollback_to": rb["version"],
+        "rollback_bit_identical":
+            bool(np.array_equal(restored, v1_baseline)),
+    }
+
+
+def corrupt_artifacts(packed, tmpdir):
+    """One tampered .npz per validated structural field (save() does not
+    re-validate, so these are exactly the ingest-rejection inputs)."""
+    import copy
+
+    out = []
+
+    def emit(label, mutate):
+        p = copy.deepcopy(packed)
+        mutate(p)
+        path = os.path.join(tmpdir, f"corrupt_{label}.npz")
+        p.save(path)
+        out.append((label, path))
+
+    emit("cycle", lambda p: p.left.__setitem__((0, 0), 0))
+    emit("dangling", lambda p: p.left.__setitem__(
+        (0, 0), p.left.shape[1] + 7))
+    emit("bad_feature", lambda p: p.split_feature.__setitem__(
+        (0, 0), p.num_feature() + 3))
+    def nan_leaf(p):
+        # the NaN must land on a REAL leaf slot — non-leaf cells are
+        # dead storage the validator rightly ignores
+        p.leaf_value[0, int(np.argmax(p.is_leaf[0]))] = np.nan
+
+    emit("nonfinite_leaf", nan_leaf)
+    return out
+
+
+def main():
+    import jax
+
+    n_trees = int(sys.argv[1]) if len(sys.argv) > 1 else 60
+    out_path = sys.argv[2] if len(sys.argv) > 2 else "BENCH_SERVE_r12.json"
+
+    booster, X = build_model(n_trees)
+    packed = pack_booster(booster)
+    rows = [X[i] for i in range(512)]
+    probe = np.stack(rows[:32])
+
+    booster2 = lgb.train(
+        {"objective": "regression", "num_leaves": 15, "verbosity": -1},
+        lgb.Dataset(X, label=np.asarray(X[:, 0], np.float64)),
+        num_boost_round=max(n_trees // 2, 5))
+    packed2 = pack_booster(booster2)
+
+    tmpdir = tempfile.mkdtemp(prefix="loadgen_")
+    v1_path = os.path.join(tmpdir, "model_v1.npz")
+    v2_path = os.path.join(tmpdir, "model_v2.npz")
+    packed.save(v1_path)
+    packed2.save(v2_path)
+
+    bank = ModelBank(max_bucket=MAX_BUCKET, max_cache_entries=16,
+                     warm_on_deploy=True, canary_rows=8)
+    bank.deploy("m", v1_path, raw_score=False)
+    v1_baseline = bank.predict("m", probe)
+
+    dispatch_ms = calibrate(bank, "m", rows)
+    capacity_rps = MAX_BATCH / (dispatch_ms / 1e3)
+    print(f"calibrated dispatch: {dispatch_ms:.2f} ms/batch of "
+          f"{MAX_BATCH} -> capacity {capacity_rps/1e3:.1f} krows/s",
+          flush=True)
+
+    scenarios = {}
+    scenarios["open_underload"] = scenario_open_underload(
+        bank, "m", rows, dispatch_ms)
+    scenarios["closed_saturation"] = scenario_saturation(
+        bank, "m", rows, dispatch_ms, "deadline")
+    scenarios["closed_saturation_no_admission"] = scenario_saturation(
+        bank, "m", rows, dispatch_ms, "off", n_requests=1500)
+
+    faults = FaultInjector()
+    faults.arm("device_predict", after=2, times=1,
+               message="bench: device error mid-predict")
+    scenarios["closed_saturation_device_fault"] = scenario_saturation(
+        bank, "m", rows, dispatch_ms, "deadline", faults=faults)
+
+    scenarios["mixed_direct"] = scenario_mixed_direct(bank, "m", rows)
+    scenarios["hot_swap_under_load"] = scenario_hot_swap(
+        bank, "m", rows, v2_path, dispatch_ms)
+    scenarios["rollback_corrupt_artifacts"] = scenario_rollback(
+        bank, "m", probe, v1_baseline, corrupt_artifacts(packed, tmpdir))
+
+    for k, v in scenarios.items():
+        print(f"{k}: {json.dumps(v, default=str)}", flush=True)
+
+    slo = check_serve_slo_budgets()
+    sat = scenarios["closed_saturation"]
+    off = scenarios["closed_saturation_no_admission"]
+    flt = scenarios["closed_saturation_device_fault"]
+    swp = scenarios["hot_swap_under_load"]
+    rbk = scenarios["rollback_corrupt_artifacts"]
+    acceptance = {
+        "fault_saturation_miss_rate_le_1pct":
+            flt["miss_rate"] <= 0.01 and flt["errors"] == 0,
+        "shedding_active_under_saturation":
+            sat["sheds"] > 0 and flt["sheds"] > 0,
+        "shed_before_miss_vs_counterfactual":
+            sat["miss_rate"] <= 0.01 < off["miss_rate"],
+        "device_fault_fired_and_degraded":
+            flt["faults"]["fired"]["device_predict"] == 1
+            and flt["fallbacks"] > 0,
+        "hot_swap_zero_failed_inflight":
+            swp["failed_inflight"] == 0 and swp["sheds"] == 0,
+        "rollback_bit_identical":
+            rbk["all_rejected"]
+            and rbk["serving_bit_identical_after_rejections"]
+            and rbk["rollback_bit_identical"],
+        "slo_budgets_ok": all(r["ok"] for r in slo),
+    }
+    acceptance["all_green"] = all(acceptance.values())
+
+    artifact = {
+        "bench": "serving_loadgen",
+        "round": 12,
+        "backend": jax.default_backend(),
+        "model": {"n_trees": packed.num_trees,
+                  "n_features": packed.num_feature(),
+                  "depth_cap": packed.depth_cap},
+        "config": {"max_batch": MAX_BATCH, "max_bucket": MAX_BUCKET,
+                   "max_queue_depth": 64 * MAX_BATCH,
+                   "timing": "sim_clock_calibrated_dispatch"},
+        "calibration": {"dispatch_ms": dispatch_ms,
+                        "capacity_rps": capacity_rps},
+        "queue_model_reference": serve_queue_model(
+            2.0 * capacity_rps, dispatch_ms, MAX_BATCH,
+            deadline_ms=6.0 * dispatch_ms),
+        "scenarios": scenarios,
+        "slo_budgets": slo,
+        "acceptance_r12": acceptance,
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    status = "ALL GREEN" if acceptance["all_green"] else "RED"
+    print(f"wrote {out_path}; acceptance_r12 {status}")
+    return 0 if acceptance["all_green"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,7 +49,16 @@ echo "== streaming parity =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_streaming.py -q \
   -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
-# 5. trace-level budgets (slow lane)
+# 5. serving-chaos: the r12 resilience surface — deterministic fault
+#    injection (device error mid-predict, corrupt artifact, stalled
+#    compile, clock skew), admission control / shed-before-miss,
+#    hot-swap + rollback round-trips.  The SLO budget models themselves
+#    already ran in the graftlint layer above (serve_slo section).
+echo "== serving-chaos (fault injection + SLO budgets) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
+  -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# 6. trace-level budgets (slow lane)
 if [ "$full" = 1 ]; then
   echo "== budgets + recompile sweeps =="
   JAX_PLATFORMS=cpu python -m lightgbm_tpu lint --budgets -q
