@@ -61,6 +61,14 @@ def __getattr__(name):
         from . import serving
 
         return serving
+    if name in ("training", "faults"):
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    if name == "train_resumable":
+        from .training import train_resumable
+
+        return train_resumable
     if name in ("PackedForest", "PredictorRuntime", "MicroBatcher",
                 "pack_booster"):
         from . import serving

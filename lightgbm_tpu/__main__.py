@@ -44,6 +44,14 @@ serve warm).  The model is ModelBank-backed: ``!swap <model.npz>`` /
 ``!rollback`` / ``!stats`` request lines are control commands (acks on
 stderr), and SIGTERM drains gracefully — stop admitting, flush
 in-flight, final stats snapshot on stderr.
+
+r13 fault-tolerant training keys (``task=train``): ``checkpoint_dir=``
+turns on the resumable loop — atomic checkpoints every
+``checkpoint_rounds`` (default 10), ``checkpoint_keep`` generations
+retained (default 2), and ``resume=true|false`` (default true: pick up
+the newest valid checkpoint, bit-identical continuation).  SIGTERM
+finishes the in-flight round, checkpoints, and exits 0, so a preempted
+job resumes by rerunning the same command line.
 """
 
 from __future__ import annotations
@@ -136,17 +144,44 @@ def main(argv: Optional[List[str]] = None) -> int:
             raise SystemExit("task=train requires data=<file>")
         data, names = _load_table(data_path, header)
         X, y = _split_label(data, names, label_spec)
+        ckpt_dir = cfg.pop("checkpoint_dir", None)
         params = dict(cfg)  # remaining keys ARE the LightGBM params;
         # train() resolves every num-rounds alias from them itself
         dtrain = lgb.Dataset(X, label=y)
-        valid_sets = None
-        if valid_path:
-            valid_sets = []
-            for vp in valid_path.split(","):  # upstream: comma-separated
-                vdata, vnames = _load_table(vp.strip(), header)
-                Xv, yv = _split_label(vdata, vnames, label_spec)
-                valid_sets.append(dtrain.create_valid(Xv, label=yv))
-        booster = lgb.train(params, dtrain, valid_sets=valid_sets)
+        if ckpt_dir:
+            # fault-tolerant path (r13): auto-checkpoint + SIGTERM drain
+            # + resume; a preempted run exits 0 with the checkpoint noted
+            # so schedulers can simply relaunch the same command line
+            from .engine import _resolve_num_rounds
+            from .training import train_resumable
+
+            ckpt_rounds = int(params.pop("checkpoint_rounds", 10))
+            keep_last = int(params.pop("checkpoint_keep", 2))
+            resume = str(params.pop("resume", "true")).lower() \
+                in ("true", "1", "yes")
+            rounds = _resolve_num_rounds(params, 100)
+            result = train_resumable(
+                params, dtrain, rounds, checkpoint_dir=ckpt_dir,
+                checkpoint_rounds=ckpt_rounds, keep_last=keep_last,
+                resume=resume)
+            booster = result.booster
+            if result.resumed_from:
+                print(f"[lightgbm_tpu] resumed from "
+                      f"{result.resumed_from}")
+            if result.preempted:
+                print(f"[lightgbm_tpu] preempted at round "
+                      f"{result.rounds_done}/{rounds}; state -> "
+                      f"{result.last_checkpoint} (rerun to resume)")
+                return 0
+        else:
+            valid_sets = None
+            if valid_path:
+                valid_sets = []
+                for vp in valid_path.split(","):  # upstream: comma-sep
+                    vdata, vnames = _load_table(vp.strip(), header)
+                    Xv, yv = _split_label(vdata, vnames, label_spec)
+                    valid_sets.append(dtrain.create_valid(Xv, label=yv))
+            booster = lgb.train(params, dtrain, valid_sets=valid_sets)
         booster.save_model(output_model)
         print(f"[lightgbm_tpu] finished training; model -> {output_model}")
         return 0

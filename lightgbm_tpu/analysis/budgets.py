@@ -1036,3 +1036,131 @@ def check_serve_slo_budgets(names: Optional[List[str]] = None
     specs = (SERVE_SLO_BUDGETS if names is None
              else [serve_slo_budget_by_name(n) for n in names])
     return [b.check() for b in specs]
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint-overhead budgets (r13): fault-tolerant training must not tax
+# throughput — auto-checkpointing at the default cadence stays <=5% of
+# round wall clock.
+#
+#   HOST_WRITE_BYTES_PER_S  — sustained sequential write rate of the
+#       checkpoint target (local NVMe-class SSD, conservative 1.5 GB/s).
+#   CKPT_DIGEST_BYTES_PER_S — single-core integrity-layer throughput
+#       (sha256 over the payload + per-field crc32s); the checksums that
+#       make torn-write detection work are charged, not treated as free.
+#   CKPT_FIXED_LATENCY_S    — per-checkpoint constant: device->host state
+#       gather dispatch, fsync, rename (~10 ms).
+#   TRAIN_ROWS_PER_S        — measured training throughput (rows/s/round)
+#       at the r5 fused reference (PERF.md); the round denominator is
+#       charged from MEASURED wall clock, not the one-hot-matmul flop
+#       model, so the overhead fraction means what it says.
+# ---------------------------------------------------------------------------
+
+HOST_WRITE_BYTES_PER_S = 1.5e9
+CKPT_DIGEST_BYTES_PER_S = 1.5e9
+CKPT_FIXED_LATENCY_S = 10e-3
+TRAIN_ROWS_PER_S = 7.2e6
+
+
+def ckpt_overhead_time(n_rows: int = 11_000_000, num_leaves: int = 255,
+                       trees_so_far: int = 200, rounds_between: int = 10,
+                       num_class: int = 1) -> Dict[str, float]:
+    """Checkpoint cost vs training time between checkpoints.
+
+    Checkpoint bytes = the training-state vectors (``pred_train`` [n,K]
+    + ``bag`` [n], f32) + the forest so far (per node slot: 4 i32 +
+    3 f32 + 1 bool = 29 B across the Tree field arrays) + header/meta.
+    The write AND the integrity digest are charged serially (both run on
+    the host thread between rounds), plus the fixed fsync/rename cost.
+    The denominator is ``rounds_between`` rounds at the measured
+    ``TRAIN_ROWS_PER_S``.  Returns bytes, per-leg times, and
+    ``overhead_frac``.
+    """
+    n_pad = -(-int(n_rows) // 256) * 256
+    nodes = 2 * int(num_leaves) - 1
+    node_bytes = 7 * 4 + 1
+    state_bytes = 4 * n_pad * int(num_class) + 4 * n_pad
+    forest_bytes = int(trees_so_far) * int(num_class) * nodes * node_bytes
+    ckpt_bytes = state_bytes + forest_bytes + 4096
+    write_s = ckpt_bytes / HOST_WRITE_BYTES_PER_S
+    digest_s = ckpt_bytes / CKPT_DIGEST_BYTES_PER_S
+    ckpt_s = write_s + digest_s + CKPT_FIXED_LATENCY_S
+    round_s = int(n_rows) / TRAIN_ROWS_PER_S
+    span_s = max(int(rounds_between), 1) * round_s
+    return {
+        "ckpt_bytes": float(ckpt_bytes),
+        "ckpt_mb": ckpt_bytes / 1e6,
+        "write_ms": write_s * 1e3,
+        "digest_ms": digest_s * 1e3,
+        "ckpt_ms": ckpt_s * 1e3,
+        "round_ms": round_s * 1e3,
+        "overhead_frac": ckpt_s / span_s,
+    }
+
+
+@dataclass(frozen=True)
+class CkptBudget:
+    """One checkpoint-overhead invariant at a reference operating point.
+
+    ``cmp`` is "le" (overhead must stay under the budget — the real
+    acceptance bars) or "ge" (budgeted from BELOW: the operating point
+    is MEANT to be expensive, proving the model separates cadences —
+    the same guard-the-model pattern as ``serve_miss_without_admission``).
+    """
+
+    name: str
+    budget: float
+    cmp: str = "le"
+    n_rows: int = 11_000_000
+    num_leaves: int = 255
+    trees_so_far: int = 200
+    rounds_between: int = 10
+    num_class: int = 1
+    note: str = ""
+
+    def check(self) -> Dict[str, object]:
+        t = ckpt_overhead_time(
+            self.n_rows, self.num_leaves, self.trees_so_far,
+            self.rounds_between, self.num_class)
+        frac = t["overhead_frac"]
+        ok = frac <= self.budget if self.cmp == "le" else frac >= self.budget
+        return {"name": self.name, "mode": "ckpt_overhead",
+                "measured": round(frac, 5), "budget": self.budget,
+                "cmp": self.cmp, "ckpt_mb": round(t["ckpt_mb"], 2),
+                "ckpt_ms": round(t["ckpt_ms"], 2),
+                "round_ms": round(t["round_ms"], 2),
+                "ok": ok, "note": self.note}
+
+
+CKPT_BUDGETS: Tuple[CkptBudget, ...] = (
+    CkptBudget("ckpt_overhead_ref", 0.05,
+               note="r13 acceptance: <=5% throughput overhead at "
+                    "checkpoint_rounds=10, Higgs-scale rows, 200-tree "
+                    "forest"),
+    CkptBudget("ckpt_overhead_deep_forest", 0.05, trees_so_far=2000,
+               note="the forest term stays amortized even at 2000 "
+                    "trees (state vectors dominate at 11M rows)"),
+    CkptBudget("ckpt_overhead_small_shard", 0.05, n_rows=1_048_576,
+               trees_so_far=500,
+               note="1M-row shard, 500 trees: fixed fsync+digest costs "
+                    "still amortize under the default cadence"),
+    CkptBudget("ckpt_every_round_uneconomic", 0.05, cmp="ge",
+               n_rows=131_072, trees_so_far=500, rounds_between=1,
+               note="guard-the-model: checkpointing EVERY round at one "
+                    "131k-row shard costs >5% of the round — the "
+                    "default cadence is load-bearing, not decorative"),
+)
+
+
+def ckpt_budget_by_name(name: str) -> CkptBudget:
+    for b in CKPT_BUDGETS:
+        if b.name == name:
+            return b
+    raise KeyError(name)
+
+
+def check_ckpt_budgets(names: Optional[List[str]] = None
+                       ) -> List[Dict[str, object]]:
+    specs = (CKPT_BUDGETS if names is None
+             else [ckpt_budget_by_name(n) for n in names])
+    return [b.check() for b in specs]

@@ -95,8 +95,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         failed |= any(not r["ok"] for r in res)
 
     # pure arithmetic — always on, like the VMEM estimates
-    from .budgets import (check_comm_budgets, check_comm_time_budgets,
-                          check_serve_slo_budgets, check_stream_budgets)
+    from .budgets import (check_ckpt_budgets, check_comm_budgets,
+                          check_comm_time_budgets, check_serve_slo_budgets,
+                          check_stream_budgets)
 
     res = check_comm_budgets()
     sections["comm_budgets"] = res
@@ -112,6 +113,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     res = check_serve_slo_budgets()
     sections["serve_slo"] = res
+    failed |= any(not r["ok"] for r in res)
+
+    res = check_ckpt_budgets()
+    sections["ckpt"] = res
     failed |= any(not r["ok"] for r in res)
 
     if budgets:
@@ -136,7 +141,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         for line in l1["stale_suppressions"]:
             print(f"stale baseline entry: {line}")
         for key in ("vmem", "comm_budgets", "comm_time", "stream_time",
-                    "serve_slo", "launch_budgets", "recompile"):
+                    "serve_slo", "ckpt", "launch_budgets", "recompile"):
             for r in sections.get(key, ()):
                 mark = "ok" if r["ok"] else "FAIL"
                 detail = (f"{r['estimated_mb']}/{r['budget_mb']} MB"

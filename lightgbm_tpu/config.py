@@ -325,6 +325,13 @@ _FRAMEWORK_KEYS = {
                            # sketch (def. 200k, matching the in-memory
                            # fit's sample_cnt)
     "stream_sketch_eps",   # GK sketch rank-error target (def. 1e-3)
+    "checkpoint_rounds",   # fault-tolerant training (r13): auto-checkpoint
+                           # cadence in rounds (def. 10 — <=5% overhead per
+                           # analysis.budgets.CKPT_BUDGETS)
+    "checkpoint_keep",     # checkpoints retained on disk (def. 2: newest
+                           # + one fallback generation for torn writes)
+    "finite_screen",       # gradient/hessian finiteness screen before each
+                           # streamed/resumable round (def. true)
 }
 
 _BOOSTING_ALIASES: Dict[str, str] = {

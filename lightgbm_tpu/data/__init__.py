@@ -5,8 +5,8 @@ host-resident binned block storage with an async double-buffered
 host->HBM prefetcher, and the streamed per-block training drivers.
 """
 
-from .block_store import BlockStore
-from .sketch import GKSummary, StreamingBinMapperBuilder
+from .block_store import BlockStore, OOCBlockError
+from .sketch import GKSummary, StreamingBinMapperBuilder, schema_digest
 from .stream_grow import (
     stream_goss_round,
     stream_grow_tree,
@@ -15,7 +15,9 @@ from .stream_grow import (
 
 __all__ = [
     "BlockStore",
+    "OOCBlockError",
     "GKSummary",
+    "schema_digest",
     "StreamingBinMapperBuilder",
     "stream_goss_round",
     "stream_grow_tree",

@@ -27,11 +27,38 @@ the in-memory ``_hist_from_segstats`` chunking exactly:
 
 from __future__ import annotations
 
+import time
+import zlib
 from typing import Iterator, List, Tuple
 
 import numpy as np
 
 from ..dataset import ROW_PAD_MULTIPLE
+
+
+class OOCBlockError(RuntimeError):
+    """A block-store read failed — always carries WHICH block.
+
+    ``kind`` classifies the quarantine reason:
+
+    * ``"corrupt"`` — the block's bytes no longer match the checksum
+      recorded at construction (host memory / file corruption);
+    * ``"short"`` — the block's shape mutated away from the layout
+      rules (rows/features no longer what the store was built with);
+    * ``"read"`` — a transient read or transfer error persisted past
+      the bounded retry.
+
+    Bare upstream exceptions (an injected :class:`FaultError`, a jax
+    transfer error) are chained as ``__cause__`` so the block index is
+    never lost on the way up (ISSUE r13 satellite).
+    """
+
+    def __init__(self, message: str, block: int, kind: str = "read",
+                 attempts: int = 1):
+        super().__init__(message)
+        self.block = int(block)
+        self.kind = kind
+        self.attempts = int(attempts)
 
 
 def _check_block_rows(block_rows: int) -> int:
@@ -61,6 +88,20 @@ class BlockStore:
                         f"multi-block store: block {k} has {b.shape[0]} "
                         f"rows, expected exactly block_rows="
                         f"{self.block_rows}")
+        # -- r13 hardening state ------------------------------------------
+        # blocks are trusted AT CONSTRUCTION (the writer just built them);
+        # the per-read verify catches anything that mutates them afterwards
+        # (host memory corruption, a bad mmap page, a buggy mutation).
+        self.checksums = [zlib.crc32(np.ascontiguousarray(b).data)
+                          for b in blocks]
+        self._shapes = [b.shape for b in blocks]
+        self.verify_checksums = True
+        self.fault_injector = None     # lightgbm_tpu.faults.FaultInjector
+        self.max_read_retries = 3      # transient-read attempts per block
+        self.retry_backoff_s = 0.05    # base of the exponential backoff
+        self._sleep = time.sleep       # injectable (tests pin to no-op)
+        self.read_retries = 0          # absorbed-transient odometer
+        self.quarantined: set = set()  # block indices that failed verify
 
     @property
     def num_blocks(self) -> int:
@@ -83,18 +124,67 @@ class BlockStore:
     def dtype(self):
         return self.blocks[0].dtype
 
+    def _verify_block(self, k: int) -> np.ndarray:
+        """Integrity screen for block ``k`` (shape then checksum); a
+        failure quarantines the block — no retry can help, the bytes are
+        gone — and raises the typed error immediately."""
+        b = self.blocks[k]
+        if b.shape != self._shapes[k]:
+            self.quarantined.add(k)
+            raise OOCBlockError(
+                f"block {k} is short/misshapen: {b.shape} vs the "
+                f"{self._shapes[k]} it was built with", block=k,
+                kind="short")
+        if self.verify_checksums and \
+                zlib.crc32(np.ascontiguousarray(b).data) \
+                != self.checksums[k]:
+            self.quarantined.add(k)
+            raise OOCBlockError(
+                f"block {k} failed its checksum (host-side corruption "
+                "after construction)", block=k, kind="corrupt")
+        return b
+
+    def _fetch_device(self, k: int):
+        """Read + transfer block ``k`` with the bounded retry: transient
+        errors (injected ``block_read``/``device_put`` faults, runtime
+        transfer hiccups) back off exponentially and retry up to
+        ``max_read_retries`` times; integrity failures never retry."""
+        import jax
+
+        from ..faults import FaultError
+
+        last = None
+        for attempt in range(self.max_read_retries + 1):
+            if attempt:
+                self.read_retries += 1
+                self._sleep(self.retry_backoff_s * (2 ** (attempt - 1)))
+            try:
+                if self.fault_injector is not None:
+                    self.fault_injector.check("block_read")
+                b = self._verify_block(k)
+                if self.fault_injector is not None:
+                    self.fault_injector.check("device_put")
+                return jax.device_put(b)
+            except OOCBlockError:
+                raise                      # quarantined: not transient
+            except (FaultError, RuntimeError, OSError) as e:
+                last = e
+        raise OOCBlockError(
+            f"block {k} read failed after "
+            f"{self.max_read_retries + 1} attempts: {last}", block=k,
+            kind="read",
+            attempts=self.max_read_retries + 1) from last
+
     def device_blocks(self) -> Iterator[Tuple[int, "object"]]:
         """Yield ``(row_offset, device_block)`` with one-block lookahead:
         block k+1's ``jax.device_put`` is issued BEFORE block k is handed
         to the consumer, so its host->HBM copy runs while the consumer's
         histogram kernel chews on block k (async dispatch)."""
-        import jax
-
-        nxt = jax.device_put(self.blocks[0])
+        nxt = self._fetch_device(0)
         for k in range(len(self.blocks)):
             cur = nxt
             if k + 1 < len(self.blocks):
-                nxt = jax.device_put(self.blocks[k + 1])
+                nxt = self._fetch_device(k + 1)
             self.bytes_streamed += self.blocks[k].nbytes
             yield k * self.block_rows, cur
 

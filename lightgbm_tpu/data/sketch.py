@@ -343,3 +343,33 @@ class StreamingBinMapperBuilder:
             n_bins[f] = nb
         return BinMapper(bounds, nan_bin, n_bins,
                          np.zeros(self.num_features, dtype=bool))
+
+
+def schema_digest(mapper: BinMapper) -> str:
+    """Stable fingerprint of a binning schema (checkpoint compatibility).
+
+    A saved forest's ``split_bin`` thresholds and ``split_feature``
+    indices only mean anything under the exact binning they were trained
+    with — the SAME invariant :meth:`Booster.ingest_init_model` enforces
+    structurally.  Checkpoints store this digest instead of the full
+    mapper: resume recomputes it from the offered Dataset and a mismatch
+    is an *incompatible schema*, not corruption.  Covers the per-feature
+    bound arrays bit-for-bit, the nan-bin layout, categorical flags, and
+    the EFB bundling (which remaps the training column space without
+    touching ``upper_bounds``).
+    """
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(np.int64(mapper.num_features).tobytes())
+    for ub in mapper.upper_bounds:
+        h.update(np.int64(len(ub)).tobytes())
+        h.update(np.ascontiguousarray(ub, np.float64).tobytes())
+    h.update(np.ascontiguousarray(mapper.nan_bin, np.int32).tobytes())
+    h.update(np.ascontiguousarray(mapper.n_bins, np.int32).tobytes())
+    h.update(np.ascontiguousarray(mapper.is_categorical, bool).tobytes())
+    b = getattr(mapper, "bundler", None)
+    if b is not None:
+        h.update(repr(b.groups).encode())
+        h.update(np.ascontiguousarray(b.default_bins).tobytes())
+    return h.hexdigest()

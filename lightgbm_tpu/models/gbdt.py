@@ -1418,24 +1418,8 @@ class Booster:
         # the ingested trees' split_bin codes only mean something under the
         # bin mapper they were trained with — require an identical binning
         # (pass reference= to reuse the original Dataset's bins)
-        cur_m = self.train_set.bin_mapper
-        prev_m = prev._bin_mapper_for_predict()
-        same = (len(cur_m.upper_bounds) == len(prev_m.upper_bounds) and all(
-            len(a) == len(b) and np.allclose(a, b)
-            for a, b in zip(cur_m.upper_bounds, prev_m.upper_bounds)))
-        # EFB changes the TRAINING column space without touching
-        # upper_bounds — bundling must match too or the ingested trees'
-        # split_feature indices mean different columns
-        cur_b = getattr(cur_m, "bundler", None)
-        prev_b = getattr(prev_m, "bundler", None)
-        if (cur_b is None) != (prev_b is None):
-            same = False
-        elif cur_b is not None and (
-                cur_b.groups != prev_b.groups
-                or not np.array_equal(cur_b.default_bins,
-                                      prev_b.default_bins)):
-            same = False
-        if not same:
+        if not self._same_binning(self.train_set.bin_mapper,
+                                  prev._bin_mapper_for_predict()):
             raise ValueError(
                 "init_model was trained with different feature binning than "
                 "this Dataset; rebuild the Dataset with "
@@ -1461,8 +1445,35 @@ class Booster:
         self._forest_cache = None
         # restart from the PREVIOUS model's base score and replay its trees
         # into the train predictions so gradients continue where it left off
+        self._rebase_and_replay(prev.init_score_)
+
+    @staticmethod
+    def _same_binning(cur_m, prev_m) -> bool:
+        """Whether two bin mappers describe the SAME training column
+        space — identical bounds AND identical EFB bundling (bundling
+        remaps training columns without touching ``upper_bounds``)."""
+        same = (len(cur_m.upper_bounds) == len(prev_m.upper_bounds) and all(
+            len(a) == len(b) and np.allclose(a, b)
+            for a, b in zip(cur_m.upper_bounds, prev_m.upper_bounds)))
+        cur_b = getattr(cur_m, "bundler", None)
+        prev_b = getattr(prev_m, "bundler", None)
+        if (cur_b is None) != (prev_b is None):
+            return False
+        if cur_b is not None and (
+                cur_b.groups != prev_b.groups
+                or not np.array_equal(cur_b.default_bins,
+                                      prev_b.default_bins)):
+            return False
+        return same
+
+    def _rebase_and_replay(self, init_score) -> None:
+        """Rebuild ``_pred_train`` from ``init_score`` and replay the
+        current forest into it, so continued-training gradients pick up
+        exactly where the source model stopped (shared by init_model
+        ingest and the ``Booster(model_file=...)`` + ``update()`` path)."""
         ds = self.train_set
-        self.init_score_ = prev.init_score_
+        p = self.params
+        self.init_score_ = init_score
         if self._num_class > 1:
             self._pred_train = jnp.broadcast_to(
                 jnp.asarray(self.init_score_, jnp.float32)[None, :],
@@ -1489,6 +1500,150 @@ class Booster:
             for tree in self.trees:
                 self._pred_train = add(self._pred_train, tree, ds.X_binned,
                                        shrink)
+
+    def _attach_continuation(self, ds: Dataset) -> None:
+        """Attach a training Dataset to a deserialized Booster so
+        ``update()`` continues the saved model (r13 satellite).
+
+        Validates that the Dataset was binned identically to the saved
+        model (targeted error otherwise), runs the normal training setup,
+        then replays the loaded forest into the train predictions.  For
+        deterministic configs the continued rounds are bit-identical to
+        an uninterrupted run; mid-``bagging_freq`` bag state is NOT in
+        the model file — resume from a training checkpoint
+        (``lightgbm_tpu.training``) when that matters.
+        """
+        ds.construct()
+        prev_m = self._bin_mapper_for_predict()
+        if prev_m is not None and not self._same_binning(
+                ds.bin_mapper, prev_m):
+            raise ValueError(
+                "this Booster was saved under a different feature binning "
+                "than the offered Dataset (bin bounds / EFB bundling "
+                "differ); rebuild the Dataset with reference=<original "
+                "training Dataset> (or identical data) before continuing "
+                "training")
+        loaded_init = self.init_score_
+        loaded_iter = self._iter
+        self.train_set = ds
+        self._setup_training()
+        if getattr(self, "_streamed", False):
+            raise NotImplementedError(
+                "continued training from a saved model file is not "
+                "supported on a streamed (from_blocks) Dataset — resume "
+                "from a training checkpoint (lightgbm_tpu.training) "
+                "instead, which carries the streamed prediction state")
+        self._iter = loaded_iter
+        self._forest_cache = None
+        self._rebase_and_replay(loaded_init)
+
+    def _screen_finite(self, i: int) -> None:
+        """Gradient/hessian finiteness screen (r13 streaming hardening):
+        one non-finite raw prediction makes every objective's g/h
+        non-finite and the round would grow a garbage tree out of NaN
+        stats that silently poisons the rest of the run.  Costs one
+        scalar host sync — the streamed block loop it guards is a host
+        loop already.  Disable with ``finite_screen=false``."""
+        from ..faults import NonFiniteGradientError
+
+        if not bool(jnp.all(jnp.isfinite(self._pred_train))):
+            raise NonFiniteGradientError(
+                f"non-finite raw predictions entering round {i}: the "
+                "gradient/hessian stats would be non-finite and the grown "
+                "tree garbage — inspect labels/objective, or resume from "
+                "the last good checkpoint (lightgbm_tpu.training)",
+                round_index=i)
+
+    # -- checkpoint state (r13) ------------------------------------------
+    def checkpoint_state(self) -> tuple:
+        """Complete training state as ``(arrays, meta)`` host payloads.
+
+        Everything a bit-identical resume needs beyond the params:
+        the forest (raw f32 buffers — NOT the decimal JSON codec), the
+        train predictions and current bagging mask exactly as the next
+        round would consume them, the base PRNG key, round counters, and
+        the shrinkage base.  All other per-round randomness (bagging /
+        feature-fraction / GOSS keys) is re-derived from params + round
+        index by ``_sample_bag_and_fmask`` and the round functions, so
+        no raw RNG stream state beyond the base key exists.  Sharded
+        arrays gather to host here; resume re-shards lazily exactly like
+        a fresh run does.
+        """
+        if self.train_set is None or self._pred_train is None:
+            raise ValueError(
+                "checkpoint_state() needs an attached training Dataset — "
+                "this booster holds no round state")
+        import dataclasses
+
+        from ..data.sketch import schema_digest
+        from .tree import tree_to_arrays
+
+        p = self.params
+        params_dict = dataclasses.asdict(p)
+        extra = dict(params_dict.pop("extra", None) or {})
+        params_dict.update(extra)
+        arrays = {
+            "pred_train": np.asarray(self._pred_train),
+            "bag": np.asarray(self._bag),
+            "key": np.asarray(self._key),
+        }
+        init_meta = None
+        if isinstance(self.init_score_, np.ndarray):
+            arrays["init_score"] = np.asarray(self.init_score_, np.float32)
+        else:
+            init_meta = float(self.init_score_)
+        trees = list(self.trees)   # materializes stacked-segment views
+        for t_idx, t in enumerate(trees):
+            for fname, arr in tree_to_arrays(t).items():
+                arrays[f"tree{t_idx:05d}/{fname}"] = arr
+        parallel = {"tree_learner": p.tree_learner}
+        if getattr(self, "_dp_mesh", None) is not None:
+            parallel["n_devices"] = int(self._dp_mesh.devices.size)
+            if getattr(self, "_dp2", False):
+                parallel["mesh"] = "dp2"
+            else:
+                merge_mode, voting_k = self._dp_merge_mode()
+                parallel["merge_mode"] = merge_mode
+                parallel["voting_k"] = int(voting_k)
+        elif getattr(self, "_fp_mesh", None) is not None:
+            parallel["n_devices"] = int(self._fp_mesh.devices.size)
+        meta = {
+            "params": params_dict,
+            "iter": int(self._iter),
+            "num_trees": len(trees),
+            "base_lr": float(self._base_lr),
+            "init_score": init_meta,
+            "best_iteration": int(self.best_iteration),
+            "streamed": bool(getattr(self, "_streamed", False)),
+            "parallel": parallel,
+            "schema_digest": schema_digest(self.train_set.bin_mapper),
+        }
+        return arrays, meta
+
+    def restore_checkpoint_state(self, arrays, meta) -> None:
+        """Inverse of :meth:`checkpoint_state` onto a booster already
+        constructed with the SAME params and an equivalently-binned
+        training Dataset (``training.checkpoint.resume_booster`` wraps
+        the construction + schema validation)."""
+        from .tree import tree_from_arrays
+
+        trees = []
+        for t_idx in range(int(meta["num_trees"])):
+            prefix = f"tree{t_idx:05d}/"
+            fields = {k[len(prefix):]: v for k, v in arrays.items()
+                      if k.startswith(prefix)}
+            trees.append(tree_from_arrays(fields))
+        self.trees = _TreeStore(trees)
+        self._forest_cache = None
+        self._iter = int(meta["iter"])
+        self._base_lr = float(meta["base_lr"])
+        self.best_iteration = int(meta["best_iteration"])
+        self.init_score_ = (
+            float(meta["init_score"]) if meta.get("init_score") is not None
+            else np.asarray(arrays["init_score"], np.float32))
+        self._pred_train = jnp.asarray(arrays["pred_train"])
+        self._bag = jnp.asarray(arrays["bag"])
+        self._key = jnp.asarray(arrays["key"])
 
     def _sample_bag_and_fmask(self, i: int):
         """Per-round stochasticity shared by plain and DART rounds: resample
@@ -1523,8 +1678,17 @@ class Booster:
     def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
         """Run one boosting round (LightGBM Booster.update)."""
         if train_set is not None and train_set is not self.train_set:
-            self.train_set = train_set
-            self._setup_training()
+            if self.train_set is None and len(self.trees) > 0:
+                # a Booster(model_file=...) continuing training: attach
+                # the dataset AND replay the loaded forest into the train
+                # predictions so the gradients continue where the saved
+                # run left off (r13 satellite — _setup_training alone
+                # resets predictions to the init score and the next round
+                # would re-learn the forest's contribution)
+                self._attach_continuation(train_set)
+            else:
+                self.train_set = train_set
+                self._setup_training()
         if self.params.boosting == "dart":
             return self._dart_round()
         ds = self.train_set
@@ -1548,6 +1712,9 @@ class Booster:
         if getattr(self, "_streamed", False):
             from ..data.stream_grow import (stream_goss_round,
                                             stream_plain_round)
+
+            if p.extra.get("finite_screen", True):
+                self._screen_finite(i)
 
             renew_alpha = getattr(self.obj, "renew_alpha", None)
             renew_scale = getattr(self.obj, "renew_scale", None)

@@ -2243,3 +2243,41 @@ def fit_linear_leaves(tree: Tree, row_leaf: jnp.ndarray, xraw: jnp.ndarray,
                              linear_coef=coef)
     delta = intercept[row_leaf] + jnp.sum(coef[row_leaf] * xg, axis=1)
     return new_tree, delta
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint codec (r13): a Tree as a flat dict of host arrays and back.
+# Unlike the JSON model format (utils/serialize.py) this is BIT-EXACT —
+# float fields round-trip as raw f32 buffers, never through decimal — so
+# resumed training replays the identical forest the interrupted run held.
+# Handles single-class [M] and stacked multiclass [K, M] field layouts
+# uniformly (np.asarray carries whatever rank the field has).
+# ---------------------------------------------------------------------------
+
+_TREE_OPTIONAL_FIELDS = ("is_cat_split", "cat_mask", "linear_feat",
+                         "linear_coef")
+
+
+def tree_to_arrays(tree: Tree) -> dict:
+    """Tree -> ``{field: np.ndarray}`` (optional None fields omitted)."""
+    import numpy as np
+
+    out = {}
+    for name, val in zip(Tree._fields, tree):
+        if val is None:
+            continue
+        out[name] = np.asarray(val)
+    return out
+
+
+def tree_from_arrays(arrays: dict) -> Tree:
+    """Inverse of :func:`tree_to_arrays` (device arrays, lazily put)."""
+    kw = {}
+    for name in Tree._fields:
+        if name in arrays:
+            kw[name] = jnp.asarray(arrays[name])
+        elif name in _TREE_OPTIONAL_FIELDS:
+            kw[name] = None
+        else:
+            raise KeyError(f"tree checkpoint missing field {name!r}")
+    return Tree(**kw)

@@ -1,0 +1,39 @@
+"""Fault-tolerant training subsystem (ISSUE r13).
+
+Deterministic checkpoint/resume (:mod:`.checkpoint`), the
+preemption-safe resumable loop (:mod:`.loop`), and — together with the
+shared :mod:`lightgbm_tpu.faults` registry and the hardened
+:class:`~lightgbm_tpu.data.block_store.BlockStore` — the guarantee the
+r13 chaos bench pins: a run killed at any round (SIGTERM or injected
+fault) resumes bit-identical to the uninterrupted run.
+"""
+
+from .checkpoint import (
+    CKPT_FORMAT_VERSION,
+    CheckpointError,
+    CorruptCheckpointError,
+    IncompatibleCheckpointError,
+    latest_checkpoint,
+    list_checkpoints,
+    load_checkpoint,
+    load_latest,
+    resume_booster,
+    save_checkpoint,
+)
+from .loop import PreemptionGuard, TrainResult, train_resumable
+
+__all__ = [
+    "CKPT_FORMAT_VERSION",
+    "CheckpointError",
+    "CorruptCheckpointError",
+    "IncompatibleCheckpointError",
+    "PreemptionGuard",
+    "TrainResult",
+    "latest_checkpoint",
+    "list_checkpoints",
+    "load_checkpoint",
+    "load_latest",
+    "resume_booster",
+    "save_checkpoint",
+    "train_resumable",
+]

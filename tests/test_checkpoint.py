@@ -1,0 +1,307 @@
+"""Checkpoint/resume tests (ISSUE r13 tentpole a+b).
+
+The contract is BIT-IDENTITY, not tolerance: a run killed at ANY round
+and resumed from its checkpoint must produce the same forest — every
+tree buffer ``np.array_equal`` — and the same train predictions as the
+run that was never interrupted.  Pinned across strict and wave growers,
+in-memory and streamed (single- and multi-block, ragged tail) datasets,
+and the dryrun multi-chip mesh, plus the durability half: torn and
+corrupt checkpoint files are rejected naming the damaged field, and
+``load_latest`` falls back past them.
+"""
+
+import hashlib
+import io
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.dataset import Dataset
+from lightgbm_tpu.training import (
+    CKPT_FORMAT_VERSION,
+    CorruptCheckpointError,
+    IncompatibleCheckpointError,
+    latest_checkpoint,
+    list_checkpoints,
+    load_checkpoint,
+    load_latest,
+    resume_booster,
+    save_checkpoint,
+    train_resumable,
+)
+from lightgbm_tpu.training.checkpoint import _HEADER_LEN, CKPT_MAGIC
+
+
+def _problem(n=700, f=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0, 1, (n, f)).astype(np.float32)
+    w = rng.normal(0, 1, f)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ w)))).astype(np.float32)
+    return X, y
+
+
+def _trees_equal(a, b):
+    if len(a.trees) != len(b.trees):
+        return False
+    for ta, tb in zip(a.trees, b.trees):
+        for field in ("split_feature", "split_bin", "left", "right",
+                      "leaf_value", "is_leaf"):
+            if not np.array_equal(np.asarray(getattr(ta, field)),
+                                  np.asarray(getattr(tb, field))):
+                return False
+    return True
+
+
+def _assert_same_run(ref, got):
+    assert _trees_equal(ref, got)
+    assert np.array_equal(np.asarray(ref._pred_train),
+                          np.asarray(got._pred_train))
+
+
+# layout -> (params extra, dataset factory kind)
+#   memory        in-memory Dataset
+#   stream_one    single padded block (ceil256(700) = 768 <= 768)
+#   stream_multi  3 blocks of 256 with a ragged 188-row tail
+_LAYOUTS = {
+    "memory": None,
+    "stream_one": 768,
+    "stream_multi": 256,
+}
+
+_GROWERS = {"strict": {}, "wave": {"wave_width": 4}}
+
+
+def _make(layout, grower, seed=0, bagging=False):
+    """(params, fresh-Dataset factory) for one layout x grower cell."""
+    X, y = _problem(seed=seed)
+    p = dict(objective="binary", num_leaves=7, learning_rate=0.2,
+             max_bin=31, min_data_in_leaf=5, verbose=-1, seed=7)
+    p.update(_GROWERS[grower])
+    if bagging:
+        p.update(bagging_fraction=0.8, bagging_freq=1, feature_fraction=0.8)
+    block_rows = _LAYOUTS[layout]
+    if block_rows is None:
+        def make_ds():
+            return Dataset(X, label=y, params=dict(p))
+    else:
+        p["stream_block_rows"] = block_rows
+        blocks = [(X[lo:lo + block_rows], y[lo:lo + block_rows])
+                  for lo in range(0, len(X), block_rows)]
+        def make_ds():
+            return Dataset.from_blocks(blocks, params=dict(p))
+    return p, make_ds
+
+
+def _reference(p, make_ds, rounds):
+    b = lgb.Booster(dict(p), make_ds())
+    for _ in range(rounds):
+        b.update()
+    return b
+
+
+ROUNDS = 4
+
+
+@pytest.mark.parametrize("layout", list(_LAYOUTS))
+@pytest.mark.parametrize("grower", list(_GROWERS))
+def test_kill_at_every_round_resumes_bit_identical(tmp_path, grower, layout):
+    """Checkpoint every round, then resume from EVERY generation k and
+    train the remaining rounds: each resumed forest must equal the
+    uninterrupted one bit for bit."""
+    p, make_ds = _make(layout, grower, bagging=(layout == "memory"))
+    ref = _reference(p, make_ds, ROUNDS)
+
+    d = str(tmp_path / "ckpts")
+    res = train_resumable(dict(p), make_ds(), ROUNDS, checkpoint_dir=d,
+                          checkpoint_rounds=1, keep_last=ROUNDS + 1,
+                          resume=False)
+    assert res.completed and not res.preempted
+    assert res.rounds_done == ROUNDS
+    _assert_same_run(ref, res.booster)
+
+    paths = list_checkpoints(d)
+    assert [load_checkpoint(q)[1]["iter"] for q in paths] \
+        == list(range(1, ROUNDS + 1))
+    for k, path in zip(range(1, ROUNDS), paths):
+        b = resume_booster(path, make_ds())
+        assert b._iter == k
+        for _ in range(ROUNDS - k):
+            b.update()
+        _assert_same_run(ref, b)
+
+
+def test_sigterm_drains_checkpoints_and_resumes(tmp_path):
+    """A real SIGTERM mid-run: the in-flight round completes, a
+    checkpoint lands, and a second invocation resumes to the same
+    forest as the uninterrupted run."""
+    p, make_ds = _make("memory", "strict", bagging=True)
+    ref = _reference(p, make_ds, 6)
+    d = str(tmp_path / "ckpts")
+
+    def kill_at(booster, i):
+        if i == 2:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    res = train_resumable(dict(p), make_ds(), 6, checkpoint_dir=d,
+                          checkpoint_rounds=10, resume=False,
+                          round_callbacks=[kill_at])
+    assert res.preempted and not res.completed
+    assert res.rounds_done == 3          # round index 2 finished
+    assert res.last_checkpoint is not None
+    assert load_checkpoint(res.last_checkpoint)[1]["iter"] == 3
+
+    res2 = train_resumable(dict(p), make_ds(), 6, checkpoint_dir=d,
+                           checkpoint_rounds=10, resume=True)
+    assert res2.completed and res2.resumed_from == res.last_checkpoint
+    _assert_same_run(ref, res2.booster)
+
+
+def test_dp_mesh_resume_bit_identical(tmp_path):
+    """Dryrun multi-chip (8 virtual CPU devices): the checkpoint carries
+    the merge-mode config and resume stays bit-identical."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual multi-device mesh")
+    p, make_ds = _make("memory", "strict")
+    p.update(tree_learner="data", histogram_merge="reduce_scatter")
+    ref = _reference(p, make_ds, 3)
+
+    d = str(tmp_path / "ckpts")
+    b = lgb.Booster(dict(p), make_ds())
+    b.update()
+    save_checkpoint(b, d)
+    meta = load_checkpoint(latest_checkpoint(d))[1]
+    assert meta["parallel"]["tree_learner"] == "data"
+    assert meta["parallel"]["merge_mode"] == "reduce_scatter"
+
+    r = resume_booster(latest_checkpoint(d), make_ds())
+    for _ in range(2):
+        r.update()
+    _assert_same_run(ref, r)
+
+
+# -- durability: torn / corrupt artifacts --------------------------------
+
+
+def _one_checkpoint(tmp_path, rounds=2):
+    p, make_ds = _make("memory", "strict")
+    b = lgb.Booster(dict(p), make_ds())
+    for _ in range(rounds):
+        b.update()
+    d = str(tmp_path / "ckpts")
+    return save_checkpoint(b, d), make_ds
+
+
+def _rewrite_payload(path, mutate):
+    """Re-serialize a checkpoint with one array mutated and the OUTER
+    sha256 recomputed — so only the per-field crc can catch it."""
+    blob = open(path, "rb").read()
+    with np.load(io.BytesIO(blob[_HEADER_LEN:])) as z:
+        arrays = {k: np.array(z[k]) for k in z.files}
+    mutate(arrays)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    payload = buf.getvalue()
+    header = (CKPT_MAGIC + np.uint32(CKPT_FORMAT_VERSION).tobytes()
+              + hashlib.sha256(payload).digest())
+    with open(path, "wb") as f:
+        f.write(header + payload)
+
+
+@pytest.mark.parametrize("field", ["pred_train", "key",
+                                   "tree00000/leaf_value",
+                                   "tree00001/split_bin"])
+def test_per_field_corruption_rejected_naming_field(tmp_path, field):
+    path, _ = _one_checkpoint(tmp_path)
+
+    def flip(arrays):
+        a = arrays[field]
+        view = a.view(np.uint8).reshape(-1)
+        view[0] ^= 0xFF
+    _rewrite_payload(path, flip)
+    with pytest.raises(CorruptCheckpointError) as ei:
+        load_checkpoint(path)
+    assert ei.value.field == field
+    assert field in str(ei.value)
+
+
+def test_torn_write_truncation_rejected(tmp_path):
+    path, _ = _one_checkpoint(tmp_path)
+    blob = open(path, "rb").read()
+    for cut in (0, _HEADER_LEN - 5, _HEADER_LEN + 10, len(blob) - 1):
+        open(path, "wb").write(blob[:cut])
+        with pytest.raises(CorruptCheckpointError):
+            load_checkpoint(path)
+
+
+def test_payload_bitrot_caught_by_sha256(tmp_path):
+    path, _ = _one_checkpoint(tmp_path)
+    blob = bytearray(open(path, "rb").read())
+    blob[_HEADER_LEN + 100] ^= 0x01
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(CorruptCheckpointError, match="sha256"):
+        load_checkpoint(path)
+
+
+def test_bad_magic_and_version_rejected(tmp_path):
+    path, _ = _one_checkpoint(tmp_path)
+    blob = bytearray(open(path, "rb").read())
+    wrong = bytes(blob).replace(CKPT_MAGIC, b"NOTLGBTP", 1)
+    open(path, "wb").write(wrong)
+    with pytest.raises(CorruptCheckpointError, match="magic"):
+        load_checkpoint(path)
+    blob[len(CKPT_MAGIC):len(CKPT_MAGIC) + 4] = \
+        np.uint32(CKPT_FORMAT_VERSION + 9).tobytes()
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(IncompatibleCheckpointError, match="format"):
+        load_checkpoint(path)
+
+
+def test_schema_drift_rejected(tmp_path):
+    path, _ = _one_checkpoint(tmp_path)
+    X2, y2 = _problem(seed=99)
+    other = Dataset(X2 * 3.0 + 1.0, label=y2)
+    with pytest.raises(IncompatibleCheckpointError, match="binning"):
+        resume_booster(path, other)
+
+
+def test_load_latest_falls_back_past_corrupt_newest(tmp_path):
+    p, make_ds = _make("memory", "strict")
+    d = str(tmp_path / "ckpts")
+    b = lgb.Booster(dict(p), make_ds())
+    b.update()
+    save_checkpoint(b, d)
+    b.update()
+    newest = save_checkpoint(b, d)
+    blob = bytearray(open(newest, "rb").read())
+    blob[-1] ^= 0xFF
+    open(newest, "wb").write(bytes(blob))
+
+    path, found = load_latest(d)
+    assert path is not None and path != newest
+    assert found["meta"]["iter"] == 1
+    assert [q for q, _ in found["rejected"]] == [newest]
+
+    # and the resumable loop rides the fallback to the same forest
+    ref = _reference(p, make_ds, 4)
+    with pytest.warns(UserWarning, match="corrupt checkpoint"):
+        res = train_resumable(dict(p), make_ds(), 4, checkpoint_dir=d,
+                              checkpoint_rounds=10, resume=True)
+    assert res.completed and res.resumed_from == path
+    _assert_same_run(ref, res.booster)
+
+
+def test_keep_last_prunes_old_generations(tmp_path):
+    p, make_ds = _make("memory", "strict")
+    d = str(tmp_path / "ckpts")
+    res = train_resumable(dict(p), make_ds(), 5, checkpoint_dir=d,
+                          checkpoint_rounds=1, keep_last=2, resume=False)
+    assert res.completed
+    paths = list_checkpoints(d)
+    assert len(paths) == 2
+    assert load_checkpoint(paths[-1])[1]["iter"] == 5
+    assert not [n for n in os.listdir(d) if n.startswith(".tmp-")]

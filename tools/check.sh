@@ -58,7 +58,18 @@ echo "== serving-chaos (fault injection + SLO budgets) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
   -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
-# 6. trace-level budgets (slow lane)
+# 6. training-chaos: the r13 recovery surface — checkpoint/resume
+#    bit-identity (kill at any round, strict/wave/streamed/dp),
+#    SIGTERM drain, torn/corrupt checkpoint rejection per field,
+#    block-read retry absorption, gradient finiteness screen.  The
+#    checkpoint-overhead budget model already ran in the graftlint
+#    layer above (ckpt section).
+echo "== training-chaos (checkpoint/resume + fault injection) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py \
+  tests/test_training_chaos.py -q \
+  -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# 7. trace-level budgets (slow lane)
 if [ "$full" = 1 ]; then
   echo "== budgets + recompile sweeps =="
   JAX_PLATFORMS=cpu python -m lightgbm_tpu lint --budgets -q
