@@ -45,6 +45,17 @@ serve warm).  The model is ModelBank-backed: ``!swap <model.npz>`` /
 stderr), and SIGTERM drains gracefully — stop admitting, flush
 in-flight, final stats snapshot on stderr.
 
+r14 pod-scale serving keys: ``mesh_devices`` (power of two; shard
+dispatches across a device mesh, default 1), ``shard_policy=auto|dp|tp``
+(data-parallel row sharding — bit-identical to single-device at f32 —
+vs tree-parallel psum splitting vs the automatic batch-size x
+forest-depth chooser; default ``auto``), ``forest_precision=f32|bf16|
+int8`` (quantized resident forest with per-tree scales — ~2.3x models
+per HBM byte at int8; structural fields must narrow exactly or the
+deploy is rejected, and the canary gates quantization drift against its
+arithmetic bound).  Swaps stay mesh-wide atomic: one runtime owns all
+mesh programs, so ``!swap``/``!rollback`` remain one attribute flip.
+
 r13 fault-tolerant training keys (``task=train``): ``checkpoint_dir=``
 turns on the resumable loop — atomic checkpoints every
 ``checkpoint_rounds`` (default 10), ``checkpoint_keep`` generations
@@ -292,12 +303,32 @@ def _serve(input_model: str, cfg: Dict[str, str],
     if canary_rows < 0:
         raise die(f"canary_rows must be >= 0, got {canary_rows}")
     cache_dir = cfg.pop("compile_cache_dir", None)
+    # -- r14 pod-scale knobs, validated up front like the r12 set
+    from .serving import FOREST_PRECISIONS, SHARD_POLICIES
+    try:
+        mesh_devices = int(cfg.pop("mesh_devices", "1"))
+    except ValueError:
+        raise die("mesh_devices must be an integer") from None
+    if mesh_devices < 1 or (mesh_devices & (mesh_devices - 1)):
+        raise die(f"mesh_devices must be a power of two >= 1, "
+                  f"got {mesh_devices}")
+    shard_policy = cfg.pop("shard_policy", "auto")
+    if shard_policy not in SHARD_POLICIES:
+        raise die(f"shard_policy must be one of "
+                  f"{'|'.join(SHARD_POLICIES)}, got {shard_policy!r}")
+    forest_precision = cfg.pop("forest_precision", "f32")
+    if forest_precision not in FOREST_PRECISIONS:
+        raise die(f"forest_precision must be one of "
+                  f"{'|'.join(FOREST_PRECISIONS)}, got "
+                  f"{forest_precision!r}")
     if cfg:
         raise die(f"unknown key(s): {', '.join(sorted(cfg))}")
 
     bank = ModelBank(max_bucket=max_bucket, max_cache_entries=max_cache,
                      warm_on_deploy=warm_buckets, canary_rows=canary_rows,
-                     cache_dir=cache_dir)
+                     cache_dir=cache_dir, mesh_devices=mesh_devices,
+                     shard_policy=shard_policy,
+                     forest_precision=forest_precision)
 
     def deploy(path: str) -> dict:
         if path.endswith(".npz"):

@@ -946,6 +946,49 @@ def serve_fault_p99_model(deadline_ms: float = 50.0,
             else float("inf")}
 
 
+# -- r14 pod-scale serving models --------------------------------------------
+#
+#   SERVE_DISPATCH_FIXED_S  — per-dispatch fixed cost a sharded program
+#       adds over a single-device one: ONE mesh program launch plus the
+#       shard bookkeeping (specs resolution, per-device arg slicing).
+#       One launch, not D — shard_map lowers to a single SPMD program,
+#       which is why dp dispatch overhead AMORTIZES as traversal work
+#       per device grows.  20 us is the conservative figure from the
+#       LAUNCH_OVERHEAD_US family used by the training-side budgets.
+#   SERVE_GATHER_BYTES_PER_S — rate of gathering the row-sharded f32
+#       output back to the host-visible buffer (ICI-class, conservative).
+SERVE_DISPATCH_FIXED_S = 20e-6
+SERVE_GATHER_BYTES_PER_S = 16e9
+
+
+def serve_mesh_dispatch_model(n_devices: int, dispatch_ms: float = 2.0,
+                              bucket: int = 16384,
+                              out_bytes_per_row: int = 4
+                              ) -> Dict[str, float]:
+    """Dispatch time of one dp-sharded bucket on ``n_devices`` devices.
+
+    Traversal is perfectly row-parallel (no collectives in the dp
+    route), so compute divides by D; what does NOT divide is the fixed
+    program-launch/shard-bookkeeping cost and the output gather.
+    Returns ``dispatch_ms_sharded``, ``speedup_x``, ``qps_x`` (same
+    thing — full buckets), and ``overhead_frac`` (fixed cost as a
+    fraction of the per-device compute slice — the part of the dispatch
+    that stops scaling).
+    """
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    t1 = dispatch_ms / 1e3
+    compute = t1 / n_devices
+    fixed = (0.0 if n_devices == 1 else
+             SERVE_DISPATCH_FIXED_S
+             + bucket * out_bytes_per_row / SERVE_GATHER_BYTES_PER_S)
+    td = compute + fixed
+    return {"dispatch_ms_sharded": td * 1e3,
+            "speedup_x": t1 / td,
+            "qps_x": t1 / td,
+            "overhead_frac": fixed / compute if compute > 0 else 0.0}
+
+
 @dataclass(frozen=True)
 class ServeSLOBudget:
     """One serving SLO invariant at a reference operating point.
@@ -961,7 +1004,16 @@ class ServeSLOBudget:
     * ``served_frac`` — throughput retained under overload with
       shedding (floor: ~1/utilization);
     * ``fault_inflation`` — p99 inflation under one injected device
-      fault with shedding active (ceiling).
+      fault with shedding active (ceiling);
+    * ``models_per_byte`` — r14: resident models per HBM byte at
+      ``precision`` relative to f32 (``ops.quantize.packed_model_bytes``
+      — the same layout table the runtime materializes, so the lint
+      floor and the device residency cannot drift apart);
+    * ``dp_overhead`` — r14: fixed dispatch cost of the dp-sharded
+      route as a fraction of the per-device compute slice at
+      ``mesh_devices`` (ceiling: the non-scaling part must stay small);
+    * ``dp_speedup`` — r14: modeled QPS multiple of the dp route at
+      ``mesh_devices`` (floor).
 
     ``cmp`` is "le" (measured <= budget passes) or "ge".
     Reference point: 2 ms dispatches, 128-row batches, 5 ms coalescing
@@ -977,6 +1029,8 @@ class ServeSLOBudget:
     max_batch: int = 128
     max_delay_ms: float = 5.0
     deadline_ms: float = 50.0
+    precision: str = "int8"
+    mesh_devices: int = 8
     note: str = ""
 
     def measure(self) -> float:
@@ -994,6 +1048,16 @@ class ServeSLOBudget:
             return serve_fault_p99_model(
                 self.deadline_ms, self.dispatch_ms,
                 self.max_delay_ms, shedding=True)["inflation_x"]
+        if self.kind == "models_per_byte":
+            from ..ops.quantize import models_per_byte_gain
+
+            return models_per_byte_gain(self.precision)
+        if self.kind == "dp_overhead":
+            return serve_mesh_dispatch_model(
+                self.mesh_devices, self.dispatch_ms)["overhead_frac"]
+        if self.kind == "dp_speedup":
+            return serve_mesh_dispatch_model(
+                self.mesh_devices, self.dispatch_ms)["speedup_x"]
         raise ValueError(f"unknown SLO budget kind {self.kind!r}")
 
     def check(self) -> Dict[str, object]:
@@ -1021,6 +1085,28 @@ SERVE_SLO_BUDGETS: Tuple[ServeSLOBudget, ...] = (
     ServeSLOBudget("serve_fault_p99_inflation", "fault_inflation", 8.0,
                    note="one device fault inflates p99 <=8x (capped at "
                         "deadline+dispatch by shed-before-miss)"),
+    # -- r14 pod-scale entries ------------------------------------------------
+    ServeSLOBudget("serve_int8_models_per_byte", "models_per_byte", 1.9,
+                   cmp="ge", precision="int8",
+                   note="r14 acceptance: int8 PackedForest holds >=1.9x "
+                        "models per HBM byte vs f32 (21 B/node -> 9 "
+                        "B/node + 4 B/tree scale sidecar)"),
+    ServeSLOBudget("serve_bf16_models_per_byte", "models_per_byte", 1.5,
+                   cmp="ge", precision="bf16",
+                   note="bf16 residency floor: >=1.5x models per HBM "
+                        "byte (exact thresholds, rounded leaves, no "
+                        "scale sidecar)"),
+    ServeSLOBudget("serve_dp_dispatch_overhead", "dp_overhead", 0.10,
+                   mesh_devices=8,
+                   note="fixed dp-shard dispatch cost (launch + output "
+                        "gather) <=10% of the per-device compute slice "
+                        "at D=8 — the non-scaling remainder stays "
+                        "amortized"),
+    ServeSLOBudget("serve_dp_speedup_d4", "dp_speedup", 3.0, cmp="ge",
+                   mesh_devices=4,
+                   note="r14 acceptance: dp route delivers >=3x QPS at "
+                        "D=4 under the dispatch model (near-linear "
+                        "minus the fixed launch/gather cost)"),
 )
 
 

@@ -1,6 +1,14 @@
-"""Core TPU compute ops: histogram construction, split search, traversal."""
+"""Core TPU compute ops: histogram construction, split search, traversal,
+low-precision quantization (ring wire + packed serving forests)."""
 
 from .histogram import compute_histograms, histogram_merge, histogram_psum
+from .quantize import (
+    FOREST_PRECISIONS,
+    WIRE_DTYPES,
+    ThresholdBoundError,
+    quantize_forest,
+    wire_transfer,
+)
 from .split import (
     BestSplit,
     SplitContext,
@@ -15,6 +23,11 @@ __all__ = [
     "compute_histograms",
     "histogram_merge",
     "histogram_psum",
+    "FOREST_PRECISIONS",
+    "WIRE_DTYPES",
+    "ThresholdBoundError",
+    "quantize_forest",
+    "wire_transfer",
     "BestSplit",
     "SplitContext",
     "find_best_split",

@@ -332,52 +332,12 @@ def pad_feature_axis(hist: jnp.ndarray, n_shards: int,
     return jnp.pad(hist, pads)
 
 
-WIRE_DTYPES = ("f32", "bf16", "int8")
-
-
-def _wire_transfer(t: jnp.ndarray, axis_name: str, perm,
-                   wire_dtype: str, f_axis: int = 1) -> jnp.ndarray:
-    """One ring hop of an f32 partial-sum message in the chosen wire format.
-
-    * ``"f32"`` — plain ``ppermute``; bitwise-exact, 4 B/cell.
-    * ``"bf16"`` — round-to-bf16 on the wire, widen back on arrival;
-      2 B/cell.  Inexact: each hop loses mantissa, so trees carry a
-      documented tolerance (quality-gated, not parity-gated).
-    * ``"int8"`` — symmetric quantization with one f32 scale per
-      (feature, stat) column: ``q = clip(round(t/s), ±127)``, both ``q``
-      and the 12 B/feature scale sidecar travel the ring; 1 B/cell.
-      Per-feature scales matter: grad/hess magnitudes vary by orders of
-      magnitude across features within one message, and a per-tensor
-      scale washes out the small ones (measured: per-tensor flips
-      splits on the bench quality gate, per-feature does not).  Same
-      tolerance contract as bf16.  The EXACT int8 path (accumulate
-      counts in int8 before widening — r9's ``2^31/127`` bound) lives
-      in the accumulator; this is lossy wire compression, which is why
-      the Booster's exactness gate falls back to f32 wire rather than
-      trust the bound alone.
-
-    Quantization happens per HOP, not once: partial sums re-quantize at
-    every shard, so error compounds with ring length — the reason
-    non-f32 wire is only reachable through the ring modes, where the
-    hop boundary exists, and never through the fused ``psum`` /
-    ``psum_scatter`` collectives.
-    """
-    if wire_dtype == "f32":
-        return lax.ppermute(t, axis_name, perm)
-    if wire_dtype == "bf16":
-        return lax.ppermute(t.astype(jnp.bfloat16), axis_name,
-                            perm).astype(jnp.float32)
-    if wire_dtype == "int8":
-        red = tuple(i for i in range(t.ndim)
-                    if i not in (f_axis, t.ndim - 1))
-        s = jnp.max(jnp.abs(t), axis=red, keepdims=True) / 127.0
-        s = jnp.where(s > 0, s, 1.0)
-        q = jnp.clip(jnp.round(t / s), -127, 127).astype(jnp.int8)
-        q = lax.ppermute(q, axis_name, perm)
-        s = lax.ppermute(s, axis_name, perm)
-        return q.astype(jnp.float32) * s
-    raise ValueError(
-        f"unknown wire dtype {wire_dtype!r}; expected one of {WIRE_DTYPES}")
+# r14: the wire quantizer moved to the shared ops.quantize module (the
+# serving PackedForest quantizer reuses its symmetric-scale machinery);
+# these are re-export shims so every r10 call site — and the measured
+# quality gates behind it — stays byte-for-byte unchanged.
+from .quantize import WIRE_DTYPES  # noqa: E402  (re-export)
+from .quantize import wire_transfer as _wire_transfer  # noqa: E402
 
 
 def merge_slice_width(num_features: int, n_shards: int,

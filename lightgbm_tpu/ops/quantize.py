@@ -1,0 +1,331 @@
+"""Shared low-precision quantization: ring wire format + packed forests.
+
+Two consumers, one module (r14 factored this out of ``ops/histogram.py``
+where r10's ring-wire quantizer was born):
+
+* **Histogram wire** — :func:`wire_transfer` compresses one ring hop of
+  an f32 partial-sum message to bf16/int8 with per-(feature, stat)
+  symmetric scales.  ``ops.histogram._wire_transfer`` is a re-export
+  shim, so every r10 call site (and its measured quality gates) is
+  byte-for-byte unchanged.
+* **Packed serving forests** — :func:`quantize_forest` shrinks a
+  :class:`serving.packed.PackedForest`'s device residency: int8/bf16
+  leaf values with one symmetric f32 scale PER TREE, uint8 thresholds,
+  int16 node/feature indices.  arXiv:2011.02022 ("Booster") makes the
+  hardware case: GBDT inference is memory-bound gathers, so halving
+  resident bytes doubles the models a ModelBank fleet holds per HBM
+  byte and widens effective MXU batches.
+
+The two quantizers differ where it matters:
+
+* wire messages re-quantize at every hop (error compounds with ring
+  length, hence per-hop scales and the f32-wire exactness fallback);
+* forest quantization happens ONCE at deploy.  Thresholds are bin codes
+  — small integers — so they are stored EXACTLY or not at all: any
+  value outside the uint8/int16 container range is a hard
+  :class:`ThresholdBoundError`, never a rounding (a rounded threshold
+  silently reroutes rows; a rounded leaf value moves a prediction by a
+  bounded, auditable amount).  Only leaf VALUES are lossy, and
+  :func:`quantize_forest` returns the worst-case prediction error bound
+  alongside the arrays so the serving canary gates on arithmetic, not
+  hope.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+WIRE_DTYPES = ("f32", "bf16", "int8")
+FOREST_PRECISIONS = ("f32", "bf16", "int8")
+
+# Per-node storage bytes of a packed forest's traversal arrays by
+# precision — the layout contract shared with the serving runtime's
+# device-resident Tree AND the analysis.budgets models-per-HBM-byte
+# lint entry (one table, two consumers, no drift):
+#   f32:  split_feature i32 + split_bin i32 + left/right i32 +
+#         leaf_value f32 + is_leaf bool               = 21 B
+#   bf16: split_feature i16 + split_bin u8 + left/right i16 +
+#         leaf_value bf16 + is_leaf bool              = 10 B
+#   int8: split_feature i16 + split_bin u8 + left/right i16 +
+#         leaf_value i8 + is_leaf bool                =  9 B
+# plus (bf16/int8) one f32 scale per tree — charged separately because
+# it does not scale with node capacity.
+PACKED_NODE_BYTES = {"f32": 21, "bf16": 10, "int8": 9}
+PACKED_SCALE_BYTES_PER_TREE = {"f32": 0, "bf16": 0, "int8": 4}
+
+_I16_MAX = np.iinfo(np.int16).max
+_U8_MAX = np.iinfo(np.uint8).max
+
+
+class ThresholdBoundError(ValueError):
+    """A structural forest field does not fit its quantized container
+    exactly.  Thresholds/indices are never rounded — this is a hard
+    deploy-time error, not a tolerance."""
+
+
+def wire_transfer(t, axis_name: str, perm, wire_dtype: str,
+                  f_axis: int = 1):
+    """One ring hop of an f32 partial-sum message in the chosen wire format.
+
+    * ``"f32"`` — plain ``ppermute``; bitwise-exact, 4 B/cell.
+    * ``"bf16"`` — round-to-bf16 on the wire, widen back on arrival;
+      2 B/cell.  Inexact: each hop loses mantissa, so trees carry a
+      documented tolerance (quality-gated, not parity-gated).
+    * ``"int8"`` — symmetric quantization with one f32 scale per
+      (feature, stat) column: ``q = clip(round(t/s), ±127)``, both ``q``
+      and the 12 B/feature scale sidecar travel the ring; 1 B/cell.
+      Per-feature scales matter: grad/hess magnitudes vary by orders of
+      magnitude across features within one message, and a per-tensor
+      scale washes out the small ones (measured: per-tensor flips
+      splits on the bench quality gate, per-feature does not).  Same
+      tolerance contract as bf16.  The EXACT int8 path (accumulate
+      counts in int8 before widening — r9's ``2^31/127`` bound) lives
+      in the accumulator; this is lossy wire compression, which is why
+      the Booster's exactness gate falls back to f32 wire rather than
+      trust the bound alone.
+
+    Quantization happens per HOP, not once: partial sums re-quantize at
+    every shard, so error compounds with ring length — the reason
+    non-f32 wire is only reachable through the ring merge modes, where
+    the hop boundary exists, and never through the fused ``psum`` /
+    ``psum_scatter`` collectives.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    if wire_dtype == "f32":
+        return lax.ppermute(t, axis_name, perm)
+    if wire_dtype == "bf16":
+        return lax.ppermute(t.astype(jnp.bfloat16), axis_name,
+                            perm).astype(jnp.float32)
+    if wire_dtype == "int8":
+        red = tuple(i for i in range(t.ndim)
+                    if i not in (f_axis, t.ndim - 1))
+        s = jnp.max(jnp.abs(t), axis=red, keepdims=True) / 127.0
+        s = jnp.where(s > 0, s, 1.0)
+        q = jnp.clip(jnp.round(t / s), -127, 127).astype(jnp.int8)
+        q = lax.ppermute(q, axis_name, perm)
+        s = lax.ppermute(s, axis_name, perm)
+        return q.astype(jnp.float32) * s
+    raise ValueError(
+        f"unknown wire dtype {wire_dtype!r}; expected one of {WIRE_DTYPES}")
+
+
+# ---------------------------------------------------------------------------
+# Packed-forest quantization (serving)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class QuantizedForestArrays:
+    """Compact host-side node arrays + the audit trail of the shrink.
+
+    ``leaf_q`` is int8 (``precision="int8"``, dequantize as ``leaf_q *
+    leaf_scale[tree]``) or f32 ALREADY ROUNDED to bf16-representable
+    values (``precision="bf16"`` — stored on device as bf16; keeping the
+    host copy in rounded f32 lets the numpy oracle reproduce device
+    arithmetic exactly).  ``error_bound`` is the worst-case |quantized −
+    original| of ONE raw (unshrunk) tree-sum prediction; multiply by
+    shrinkage for the served-margin bound.
+    """
+
+    precision: str
+    split_feature: np.ndarray        # i16 [T, (K,) M]
+    split_bin: np.ndarray            # u8  [T, (K,) M]
+    left: np.ndarray                 # i16 [T, (K,) M]
+    right: np.ndarray                # i16 [T, (K,) M]
+    leaf_q: np.ndarray               # i8 / f32(bf16-rounded) [T, (K,) M]
+    is_leaf: np.ndarray              # bool [T, (K,) M]
+    leaf_scale: Optional[np.ndarray]  # f32 [T, (K,)] (int8 only)
+    error_bound: float
+    # categorical subset splits ride through unchanged — already minimal
+    # (bool); the byte model covers the numeric traversal arrays
+    is_cat_split: Optional[np.ndarray] = None
+    cat_mask: Optional[np.ndarray] = None
+
+    def dequantized_leaf_values(self) -> np.ndarray:
+        """f32 leaf values as the DEVICE will see them — the numpy-oracle
+        side of the serving canary's device-vs-oracle drift gate."""
+        if self.precision == "int8":
+            return (self.leaf_q.astype(np.float32)
+                    * self.leaf_scale[..., None])
+        return np.asarray(self.leaf_q, np.float32)
+
+    def node_bytes(self) -> int:
+        """Resident traversal bytes (node arrays + scale sidecar)."""
+        per_node = sum(a.dtype.itemsize for a in (
+            self.split_feature, self.split_bin, self.left, self.right,
+            self.is_leaf)) + (1 if self.precision == "int8"
+                              else 2 if self.precision == "bf16" else 4)
+        n_slots = int(np.prod(self.split_feature.shape))
+        scale = (self.leaf_scale.size * 4
+                 if self.leaf_scale is not None else 0)
+        return per_node * n_slots + scale
+
+
+def _check_exact(name: str, a: np.ndarray, lo: int, hi: int) -> None:
+    mn, mx = int(a.min()), int(a.max())
+    if mn < lo or mx > hi:
+        raise ThresholdBoundError(
+            f"{name} range [{mn}, {mx}] does not fit the quantized "
+            f"container [{lo}, {hi}] exactly — refusing to round a "
+            "structural field")
+
+
+def quantize_forest(split_feature: np.ndarray, split_bin: np.ndarray,
+                    left: np.ndarray, right: np.ndarray,
+                    leaf_value: np.ndarray, is_leaf: np.ndarray,
+                    precision: str,
+                    is_cat_split: Optional[np.ndarray] = None,
+                    cat_mask: Optional[np.ndarray] = None
+                    ) -> QuantizedForestArrays:
+    """Quantize packed node arrays to ``precision`` (bf16 | int8).
+
+    Structural fields are container-narrowed EXACTLY (hard
+    :class:`ThresholdBoundError` on overflow — see module docstring):
+    ``split_bin`` must fit uint8 (bin codes < 256, the repo-wide
+    ``max_bin`` ceiling), node indices and feature ids must fit int16
+    (capacity/feature count <= 32767; children use -1 sentinels).  Leaf
+    values quantize with one symmetric scale per tree: per-tree rather
+    than per-forest for the same measured reason the wire uses
+    per-feature scales — late boosting trees are orders of magnitude
+    smaller than early ones, and a shared scale washes them out.
+    """
+    if precision not in ("bf16", "int8"):
+        raise ValueError(
+            f"quantize_forest precision must be 'bf16' or 'int8', got "
+            f"{precision!r} (f32 needs no quantization)")
+    split_feature = np.asarray(split_feature)
+    split_bin = np.asarray(split_bin)
+    left = np.asarray(left)
+    right = np.asarray(right)
+    leaf_value = np.asarray(leaf_value, np.float32)
+    is_leaf = np.asarray(is_leaf, bool)
+    _check_exact("split_bin", split_bin, 0, _U8_MAX)
+    _check_exact("split_feature", split_feature, -1, _I16_MAX)
+    _check_exact("left child index", left, -1, _I16_MAX)
+    _check_exact("right child index", right, -1, _I16_MAX)
+
+    if precision == "int8":
+        # one symmetric scale per tree (per class for multiclass): only
+        # REAL leaf slots set the scale — dead slots carry grower
+        # sentinels that would inflate it
+        mag = np.max(np.abs(np.where(is_leaf, leaf_value, 0.0)), axis=-1)
+        scale = np.where(mag > 0, mag / 127.0, 1.0).astype(np.float32)
+        q = np.clip(np.round(leaf_value / scale[..., None]),
+                    -127, 127).astype(np.int8)
+        deq = q.astype(np.float32) * scale[..., None]
+        leaf_q, leaf_scale = q, scale
+    else:
+        import ml_dtypes
+
+        # round-to-nearest-even bf16 (ml_dtypes == the XLA cast), held as
+        # f32 host-side so the numpy oracle and the device share one
+        # arithmetic — quantization is a pure host-side build step, no
+        # device round-trip
+        deq = leaf_value.astype(ml_dtypes.bfloat16).astype(np.float32)
+        leaf_q, leaf_scale = deq, None
+
+    # worst-case raw-margin error: per-tree max leaf error, summed over
+    # the tree axis (each tree contributes one leaf per row), maxed over
+    # classes — arithmetic, not an estimate
+    per_tree = np.max(np.abs(np.where(is_leaf, deq - leaf_value, 0.0)),
+                      axis=-1)
+    bound = float(np.max(np.sum(per_tree, axis=0)))
+    return QuantizedForestArrays(
+        precision=precision,
+        split_feature=split_feature.astype(np.int16),
+        split_bin=split_bin.astype(np.uint8),
+        left=left.astype(np.int16),
+        right=right.astype(np.int16),
+        leaf_q=leaf_q, is_leaf=is_leaf, leaf_scale=leaf_scale,
+        error_bound=bound,
+        is_cat_split=(None if is_cat_split is None
+                      else np.asarray(is_cat_split, bool)),
+        cat_mask=(None if cat_mask is None
+                  else np.asarray(cat_mask, bool)))
+
+
+def packed_model_bytes(num_trees: int, capacity: int, num_class: int = 1,
+                       precision: str = "f32") -> int:
+    """Resident traversal bytes of one packed model at ``precision`` —
+    the arithmetic behind the ``serve_*_models_per_byte`` lint budgets
+    (same layout table the runtime materializes; see
+    :data:`PACKED_NODE_BYTES`)."""
+    if precision not in FOREST_PRECISIONS:
+        raise ValueError(
+            f"precision must be one of {FOREST_PRECISIONS}, got "
+            f"{precision!r}")
+    slots = int(num_trees) * int(num_class) * int(capacity)
+    return (PACKED_NODE_BYTES[precision] * slots
+            + PACKED_SCALE_BYTES_PER_TREE[precision]
+            * int(num_trees) * int(num_class))
+
+
+def models_per_byte_gain(precision: str, num_trees: int = 200,
+                         capacity: int = 509,
+                         num_class: int = 1) -> float:
+    """How many quantized models fit per f32 model's HBM bytes."""
+    f32 = packed_model_bytes(num_trees, capacity, num_class, "f32")
+    q = packed_model_bytes(num_trees, capacity, num_class, precision)
+    return f32 / q
+
+
+def to_device_tree(q: QuantizedForestArrays) -> Tuple[object, object]:
+    """Materialize the compact arrays as a device-resident ``Tree``.
+
+    Returns ``(tree, leaf_scale)`` where the tree's arrays keep their
+    COMPACT dtypes (int16 indices, uint8 thresholds, int8/bf16 leaves)
+    — these are the buffers that stay resident in HBM between requests;
+    the serving runtime widens them inside each compiled program, so
+    dispatch arithmetic is f32 while residency is quantized.
+    """
+    import jax.numpy as jnp
+    from ..models.tree import Tree
+
+    leaf = (jnp.asarray(q.leaf_q) if q.precision == "int8"
+            else jnp.asarray(q.leaf_q, jnp.bfloat16))
+    # count/split_gain/num_leaves are dead fields for traversal but must
+    # keep a leading tree axis (predict tree-maps pad/chunk over every
+    # field); one int8 cell per tree keeps them out of the byte budget
+    lead = q.split_feature.shape[:-1]
+    tree = Tree(
+        split_feature=jnp.asarray(q.split_feature),
+        split_bin=jnp.asarray(q.split_bin),
+        left=jnp.asarray(q.left),
+        right=jnp.asarray(q.right),
+        leaf_value=leaf,
+        is_leaf=jnp.asarray(q.is_leaf),
+        count=jnp.zeros(lead + (1,), jnp.int8),
+        split_gain=jnp.zeros(lead + (1,), jnp.int8),
+        num_leaves=jnp.zeros(lead, jnp.int32),
+        is_cat_split=(None if q.is_cat_split is None
+                      else jnp.asarray(q.is_cat_split)),
+        cat_mask=(None if q.cat_mask is None
+                  else jnp.asarray(q.cat_mask)),
+    )
+    scale = (None if q.leaf_scale is None
+             else jnp.asarray(q.leaf_scale, jnp.float32))
+    return tree, scale
+
+
+def widen_tree(tree, leaf_scale=None):
+    """In-program inverse of :func:`to_device_tree`: widen a compact tree
+    back to the i32/f32 dtypes the traversal kernels expect.  Runs inside
+    the jitted predict program, so the widened copy is transient compute
+    while the closed-over compact arrays remain the resident ones."""
+    import jax.numpy as jnp
+
+    leaf = tree.leaf_value.astype(jnp.float32)
+    if leaf_scale is not None:
+        leaf = leaf * leaf_scale[..., None]
+    return tree._replace(
+        split_feature=tree.split_feature.astype(jnp.int32),
+        split_bin=tree.split_bin.astype(jnp.int32),
+        left=tree.left.astype(jnp.int32),
+        right=tree.right.astype(jnp.int32),
+        leaf_value=leaf,
+    )

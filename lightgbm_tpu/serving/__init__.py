@@ -29,9 +29,11 @@ stats.py (counters).  The CLI front end is ``python -m lightgbm_tpu
 task=serve input_model=...``.
 """
 
+from ..ops.quantize import FOREST_PRECISIONS, ThresholdBoundError
 from .bank import ModelBank, SwapRejected
 from .faults import SITES as FAULT_SITES
 from .faults import FaultError, FaultInjector, FaultSpec
+from .mesh import SHARD_POLICIES, ServingMesh, choose_route
 from .packed import (PACKED_FORMAT_VERSION, PackedForest, PackedForestError,
                      pack_booster)
 from .queue import (SHED_POLICIES, MicroBatcher, Overloaded,
@@ -41,6 +43,7 @@ from .stats import ServingStats
 
 __all__ = [
     "FAULT_SITES",
+    "FOREST_PRECISIONS",
     "FaultError",
     "FaultInjector",
     "FaultSpec",
@@ -53,10 +56,14 @@ __all__ = [
     "PendingPrediction",
     "PredictorRuntime",
     "RequestTimeout",
+    "SHARD_POLICIES",
     "SHED_POLICIES",
+    "ServingMesh",
     "ServingStats",
     "SwapRejected",
+    "ThresholdBoundError",
     "bucket_for",
+    "choose_route",
     "enable_persistent_cache",
     "pack_booster",
 ]

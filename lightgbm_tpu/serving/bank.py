@@ -29,6 +29,21 @@ version untouched — byte-for-byte: the old runtime object (and its
 compiled programs) never went away.  ``rollback()`` flips back to the
 previous resident version the same way.
 
+r14 — pod-scale tenancy: the bank's ``mesh_devices`` / ``shard_policy``
+/ ``forest_precision`` knobs thread into every tenant's runtime.  Swaps
+stay **mesh-wide atomic**: one PredictorRuntime owns ALL of a model's
+mesh programs (dp shards, tp shards, the single-device ladder), so the
+flip is still ONE attribute assignment — there is no per-device flip to
+half-complete, and an in-flight sharded batch that resolved the old
+runtime finishes on the old forest on every device.  Quantized tenants
+get two extra gates for free: a ``ThresholdBoundError`` during the
+runtime build (a structural field that cannot be narrowed exactly)
+rejects at the build stage, and the canary cross-checks the device
+against the DEQUANTIZED oracle (``runtime.oracle``) so int8/bf16 drift
+device-vs-oracle is still held to ``canary_tol``, with the
+quantization-vs-exact drift reported separately against the arithmetic
+``quant_error_bound``.
+
 A warm manifest (``save_warm_manifest``/``restore_warm_manifest``)
 records which models, versions and bucket programs were live; together
 with jax's persistent compilation cache
@@ -46,7 +61,9 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..ops.quantize import FOREST_PRECISIONS, ThresholdBoundError
 from .faults import FaultError
+from .mesh import SHARD_POLICIES
 from .packed import PackedForest, PackedForestError
 from .runtime import (DEFAULT_CACHE_ENTRIES, DEFAULT_MAX_BUCKET,
                       PredictorRuntime, enable_persistent_cache)
@@ -100,6 +117,10 @@ class ModelBank:
       clock: injectable time source for the compile-timeout measurement.
       cache_dir: enable jax's persistent compilation cache here (see
         :func:`runtime.enable_persistent_cache`).
+      mesh_devices / shard_policy / forest_precision: pod-scale runtime
+        knobs shared by every tenant, like the bucket ladder (see
+        :class:`runtime.PredictorRuntime` and the module docstring's
+        mesh-wide-atomic note).
     """
 
     def __init__(self, max_bucket: int = DEFAULT_MAX_BUCKET,
@@ -111,11 +132,24 @@ class ModelBank:
                  compile_timeout_s: Optional[float] = None,
                  faults=None,
                  clock=time.monotonic,
-                 cache_dir: Optional[str] = None):
+                 cache_dir: Optional[str] = None,
+                 mesh_devices: int = 1,
+                 shard_policy: str = "auto",
+                 forest_precision: str = "f32"):
         if canary_rows < 0:
             raise ValueError("canary_rows must be >= 0")
+        if shard_policy not in SHARD_POLICIES:
+            raise ValueError(f"shard_policy must be one of "
+                             f"{SHARD_POLICIES}, got {shard_policy!r}")
+        if forest_precision not in FOREST_PRECISIONS:
+            raise ValueError(f"forest_precision must be one of "
+                             f"{FOREST_PRECISIONS}, got "
+                             f"{forest_precision!r}")
         self.max_bucket = int(max_bucket)
         self.max_cache_entries = int(max_cache_entries)
+        self.mesh_devices = int(mesh_devices)
+        self.shard_policy = shard_policy
+        self.forest_precision = forest_precision
         self.donate = donate
         self.warm_on_deploy = bool(warm_on_deploy)
         self.canary_rows = int(canary_rows)
@@ -186,10 +220,20 @@ class ModelBank:
                         "ingest", f"feature count changed {nf_old} -> "
                         f"{nf_new}; traffic rows would be rejected")
             stats = entry.stats if entry is not None else ServingStats()
-            rt = PredictorRuntime(
-                packed, max_bucket=self.max_bucket,
-                max_cache_entries=self.max_cache_entries,
-                donate=self.donate, stats=stats, faults=self.faults)
+            report["stage"] = "build"
+            try:
+                rt = PredictorRuntime(
+                    packed, max_bucket=self.max_bucket,
+                    max_cache_entries=self.max_cache_entries,
+                    donate=self.donate, stats=stats, faults=self.faults,
+                    mesh_devices=self.mesh_devices,
+                    shard_policy=self.shard_policy,
+                    forest_precision=self.forest_precision)
+            except ThresholdBoundError as e:
+                # a structural field does not narrow exactly at the
+                # requested precision — never round thresholds; reject
+                # and keep serving the prior (f32-or-otherwise) version
+                raise SwapRejected("build", str(e)) from e
             report["stage"] = "warm"
             report["warmed"] = self._warm(rt, warm, warm_buckets,
                                           raw_score, t0)
@@ -277,7 +321,17 @@ class ModelBank:
     def _canary(self, rt: PredictorRuntime, packed: PackedForest,
                 raw_score: bool, canary_X) -> dict:
         """A small batch through the NEW runtime, cross-checked against
-        the forest's numpy oracle before any traffic sees it."""
+        the forest's numpy oracle before any traffic sees it.
+
+        Two gates for quantized runtimes: (1) device vs the DEQUANTIZED
+        oracle (``rt.oracle`` — same leaf values the device widens to)
+        at the usual ``canary_tol``, catching real device/arithmetic
+        divergence unmasked by quantization error; (2) device vs the
+        EXACT f32 oracle at ``canary_tol + rt.quant_error_bound`` — the
+        arithmetic worst-case of the shrink, never looser: a forest
+        whose quantization drift exceeds its own proven bound is
+        corrupt, not imprecise.
+        """
         if self.canary_rows == 0 and canary_X is None:
             return {"rows": 0, "skipped": True}
         if canary_X is None:
@@ -293,16 +347,32 @@ class ModelBank:
         except FaultError as e:
             raise SwapRejected("canary", f"device fault: {e}") from e
         codes = packed.bin_mapper.transform(canary_X)
-        want = packed.predict_numpy(codes, raw_score=raw_score)
+        want = rt.oracle.predict_numpy(codes, raw_score=raw_score)
         if not np.all(np.isfinite(got)):
             raise SwapRejected("canary", "non-finite canary predictions")
-        err = float(np.max(np.abs(np.asarray(got, np.float64)
-                                  - np.asarray(want, np.float64))))
+        got64 = np.asarray(got, np.float64)
+        err = float(np.max(np.abs(got64 - np.asarray(want, np.float64))))
         if err > self.canary_tol:
             raise SwapRejected(
                 "canary", f"device-vs-oracle drift {err:.3e} > "
                 f"tol {self.canary_tol:.1e}")
-        return {"rows": int(canary_X.shape[0]), "max_abs_err": err}
+        report = {"rows": int(canary_X.shape[0]), "max_abs_err": err}
+        if rt.forest_precision != "f32":
+            exact = packed.predict_numpy(codes, raw_score=raw_score)
+            qerr = float(np.max(np.abs(got64
+                                       - np.asarray(exact, np.float64))))
+            # the bound holds on RAW margins; transformed outputs only
+            # contract (sigmoid/softmax Lipschitz < 1), so the raw bound
+            # is a valid (conservative) gate either way
+            qtol = self.canary_tol + rt.quant_error_bound
+            if qerr > qtol:
+                raise SwapRejected(
+                    "canary", f"quantization drift {qerr:.3e} exceeds "
+                    f"its own arithmetic bound {qtol:.3e} — artifact "
+                    "or quantizer corrupt")
+            report["quant_abs_err"] = qerr
+            report["quant_error_bound"] = rt.quant_error_bound
+        return report
 
     # -- reporting -----------------------------------------------------------
     def snapshot(self) -> dict:

@@ -328,14 +328,20 @@ class MicroBatcher:
                                      + 0.3 * dt)
 
     def _fallback(self, runtime, group, num_it) -> None:
-        """Device dispatch failed: unbatched CPU predict per request."""
+        """Device dispatch failed: unbatched CPU predict per request.
+
+        Uses the runtime's ``oracle`` forest (dequantized leaf values
+        for int8/bf16 runtimes), so degraded-mode answers match what the
+        device would have produced instead of silently reverting to the
+        exact f32 model mid-incident.
+        """
         if not self.fallback_unbatched:
             for r in group:
                 r.pending._set(error=RuntimeError(
                     "batched device dispatch failed and fallback is "
                     "disabled"))
             return
-        packed = runtime.packed
+        packed = getattr(runtime, "oracle", None) or runtime.packed
         mapper = packed.bin_mapper
         self.stats.record_fallback(len(group))
         for r in group:

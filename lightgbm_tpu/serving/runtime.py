@@ -18,18 +18,41 @@ The serving runtime instead:
   and training binning can never diverge);
 * batches larger than ``max_bucket`` stream through in full-bucket chunks.
 
+r14 adds the pod-scale knobs (see :mod:`serving.mesh` and
+:mod:`ops.quantize`):
+
+* ``mesh_devices``/``shard_policy`` — shard dispatches across a device
+  mesh: data-parallel row sharding (bit-identical to single-device at
+  f32), tree-parallel ``psum`` splitting, or an automatic chooser.  The
+  route is a third compile-cache key component and ``warm()`` warms the
+  chosen route per bucket, so sharded traffic pays zero traffic-path
+  compiles after a warm deploy.
+* ``forest_precision`` — keep the resident forest quantized (int8/bf16
+  leaf values with per-tree scales, uint8 thresholds, int16 indices) and
+  widen INSIDE each compiled program: dispatch arithmetic stays f32
+  while HBM residency shrinks ~2.3x (int8).  ``runtime.oracle`` is a
+  PackedForest carrying the DEQUANTIZED leaf values — the numpy
+  reference for the canary and the queue's fallback path, so
+  device-vs-oracle stays tight at any precision — and
+  ``quant_error_bound`` is the worst-case |quantized - exact| served
+  margin (arithmetic from ``ops.quantize``, not an estimate).
+
 Per-bucket counters (requests, dispatches, cache hits/misses, padding
 waste, latency quantiles) land in :class:`serving.stats.ServingStats`.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from collections import OrderedDict
 from typing import Optional
 
 import numpy as np
 
+from ..ops.quantize import (FOREST_PRECISIONS, packed_model_bytes,
+                            quantize_forest, to_device_tree, widen_tree)
+from .mesh import SHARD_POLICIES, ServingMesh, choose_route
 from .packed import PackedForest
 from .stats import ServingStats
 
@@ -83,6 +106,13 @@ class PredictorRuntime:
       faults: optional serving.faults.FaultInjector consulted at the
         ``device_predict`` site before every compiled dispatch — the
         deterministic stand-in for a device error mid-predict.
+      mesh_devices: shard dispatches across this many devices (power of
+        two; 1 = the r12 single-device behavior, unchanged).
+      shard_policy: ``auto`` | ``dp`` | ``tp`` — see
+        :func:`serving.mesh.choose_route`.
+      forest_precision: ``f32`` | ``bf16`` | ``int8`` resident forest
+        (module docstring).  Raises ``ops.quantize.ThresholdBoundError``
+        when a structural field cannot be narrowed EXACTLY.
     """
 
     def __init__(self, packed: PackedForest,
@@ -90,20 +120,54 @@ class PredictorRuntime:
                  max_cache_entries: int = DEFAULT_CACHE_ENTRIES,
                  donate: Optional[bool] = None,
                  stats: Optional[ServingStats] = None,
-                 faults=None):
+                 faults=None,
+                 mesh_devices: int = 1,
+                 shard_policy: str = "auto",
+                 forest_precision: str = "f32"):
         import jax
 
         if max_bucket < 1 or (max_bucket & (max_bucket - 1)):
             raise ValueError(f"max_bucket must be a power of two, got "
                              f"{max_bucket}")
+        if shard_policy not in SHARD_POLICIES:
+            raise ValueError(f"shard_policy must be one of "
+                             f"{SHARD_POLICIES}, got {shard_policy!r}")
+        if forest_precision not in FOREST_PRECISIONS:
+            raise ValueError(f"forest_precision must be one of "
+                             f"{FOREST_PRECISIONS}, got "
+                             f"{forest_precision!r}")
         self.packed = packed
         self.max_bucket = int(max_bucket)
         self.max_cache_entries = int(max_cache_entries)
         self.stats = stats if stats is not None else ServingStats()
         self.faults = faults
+        self.shard_policy = shard_policy
+        self.forest_precision = forest_precision
         self._donate = (jax.default_backend() == "tpu"
                         if donate is None else bool(donate))
-        self._forest = packed.to_tree()           # device-resident once
+        self.mesh = (ServingMesh(mesh_devices) if int(mesh_devices) > 1
+                     else None)
+        if forest_precision == "f32":
+            self._forest = packed.to_tree()       # device-resident once
+            self._leaf_scale = None
+            self.quant_error_bound = 0.0
+            self.oracle = packed        # fallback/canary numpy reference
+        else:
+            q = quantize_forest(
+                packed.split_feature, packed.split_bin, packed.left,
+                packed.right, packed.leaf_value, packed.is_leaf,
+                forest_precision, is_cat_split=packed.is_cat_split,
+                cat_mask=packed.cat_mask)
+            self._forest, self._leaf_scale = to_device_tree(q)
+            # served margins scale the raw tree sum by shrink; multiply
+            # the raw bound through so callers compare against outputs
+            self.quant_error_bound = q.error_bound * abs(packed.shrink)
+            self.oracle = dataclasses.replace(
+                packed, leaf_value=q.dequantized_leaf_values())
+        self.forest_nbytes = packed_model_bytes(
+            packed.num_trees, packed.capacity, packed.num_class,
+            forest_precision)
+        self._tp_padded = None          # lazily built (forest, scale, t/D)
         self._obj = packed._objective()
         self._cache: "OrderedDict[tuple, object]" = OrderedDict()
         self.num_compiles = 0                      # lifetime program builds
@@ -150,7 +214,23 @@ class PredictorRuntime:
             "num_compiles": self.num_compiles,
             "warmed_buckets": self.warmed_buckets,
             "buckets_live": sorted({k[0] for k in self._cache}),
+            # r14: shard programs are first-class cache citizens — the
+            # warm-coverage test pins that these counters see them
+            "mesh_devices": (self.mesh.devices if self.mesh else 1),
+            "forest_precision": self.forest_precision,
+            "shard_programs": sum(1 for k in self._cache
+                                  if k[2] != "single"),
+            "routes_live": sorted({k[2] for k in self._cache}),
         }
+
+    def route_for(self, bucket: int) -> str:
+        """The dispatch route this bucket resolves to — deterministic,
+        shared verbatim by ``_dispatch`` and ``warm()`` (which is what
+        makes warm coverage of shard programs provable)."""
+        if self.mesh is None:
+            return "single"
+        return choose_route(self.shard_policy, bucket,
+                            self.packed.num_trees, self.mesh.devices)
 
     def warm(self, raw_score: bool = False, buckets=None) -> int:
         """Precompile the bucket ladder before traffic arrives.
@@ -159,10 +239,14 @@ class PredictorRuntime:
         size class's compile cost lands at startup instead of on its
         first real request.  Warm batches use the same uint8 codes dtype
         the edge transform produces, so the compiled programs are
-        exactly the ones traffic will hit.  When the ladder exceeds the
-        LRU bound only the LARGEST ``max_cache_entries`` buckets are
-        warmed — warming more would evict programs just built.  Returns
-        the number of programs compiled.
+        exactly the ones traffic will hit.  With a mesh active each
+        bucket warms the ROUTE the deterministic chooser will dispatch
+        it to (dp/tp shard programs included), so the first sharded
+        batch after a swap pays zero traffic-path compiles.  When the
+        ladder exceeds the LRU bound only the LARGEST
+        ``max_cache_entries`` buckets are warmed — warming more would
+        evict programs just built.  Returns the number of programs
+        compiled.
         """
         import jax
         import jax.numpy as jnp
@@ -175,7 +259,7 @@ class PredictorRuntime:
                   else self.packed.num_feature())
         before = self.num_compiles
         for b in todo:
-            fn = self._get_fn(b, raw_score)
+            fn = self._get_fn(b, raw_score, self.route_for(b))
             jax.block_until_ready(fn(
                 jnp.zeros((b, n_cols), jnp.uint8),
                 jnp.zeros(b, jnp.float32), jnp.int32(1)))
@@ -198,30 +282,42 @@ class PredictorRuntime:
                 [codes, np.zeros((pad, codes.shape[1]), codes.dtype)])
         mask = np.zeros(bucket, np.float32)
         mask[:n] = 1.0
-        fn = self._get_fn(bucket, raw_score)
+        route = self.route_for(bucket)
+        fn = self._get_fn(bucket, raw_score, route)
         out = np.asarray(fn(jnp.asarray(codes), jnp.asarray(mask),
                             jnp.int32(k)))
         self.stats.record_dispatch(
             bucket, rows=n, padded=pad,
-            latency_s=time.perf_counter() - t0)
+            latency_s=time.perf_counter() - t0, route=route)
         return out[:n]
 
-    def _get_fn(self, bucket: int, raw_score: bool):
-        key = (bucket, bool(raw_score))
+    def _get_fn(self, bucket: int, raw_score: bool,
+                route: str = "single"):
+        key = (bucket, bool(raw_score), route)
         fn = self._cache.get(key)
         if fn is not None:
             self._cache.move_to_end(key)
             self.stats.record_cache(bucket, hit=True)
             return fn
         self.stats.record_cache(bucket, hit=False)
-        fn = self._build_fn(raw_score)
+        fn = self._build_fn(raw_score, route)
         self.num_compiles += 1
         self._cache[key] = fn
         while len(self._cache) > self.max_cache_entries:
             self._cache.popitem(last=False)        # evict LRU
         return fn
 
-    def _build_fn(self, raw_score: bool):
+    def _tp_parts(self):
+        """Tree-axis-padded (forest, leaf_scale, trees_per_device) —
+        built once, shared by every tp bucket program."""
+        if self._tp_padded is None:
+            from .mesh import pad_forest_for_tp
+
+            self._tp_padded = pad_forest_for_tp(
+                self._forest, self._leaf_scale, self.mesh.devices)
+        return self._tp_padded
+
+    def _build_fn(self, raw_score: bool, route: str = "single"):
         """One jitted fixed-shape predict program.
 
         ``num_iteration`` is traced (the forest replay masks rounds on
@@ -230,6 +326,14 @@ class PredictorRuntime:
         the row mask zeroes their outputs so no padding garbage escapes,
         and for probability transforms the masked rows are neutralized
         BEFORE the transform would see them downstream.
+
+        Routes (see :mod:`serving.mesh`): ``single`` is the r12 program;
+        ``dp`` wraps the IDENTICAL body in a row-sharding ``shard_map``
+        (bit-identical outputs at f32); ``tp`` shards the forest's tree
+        axis and ``psum``s raw margins, applying init/rf/transform/mask
+        on the replicated result.  Quantized forests widen inside the
+        program (per shard for tp), so compute is f32 while residency
+        stays compact.
         """
         import jax
         import jax.numpy as jnp
@@ -237,6 +341,8 @@ class PredictorRuntime:
 
         packed = self.packed
         forest = self._forest
+        leaf_scale = self._leaf_scale
+        quantized = self.forest_precision != "f32"
         obj = self._obj
         nc = packed.num_class
         shrink = jnp.float32(packed.shrink)
@@ -244,25 +350,49 @@ class PredictorRuntime:
         depth_cap = packed.depth_cap
         is_rf = packed.params.get("boosting") == "rf"
 
-        def fn(bins, mask, num_it):
-            if nc > 1:
-                cols = [predict_forest_binned(
-                    jax.tree.map(lambda a, c=c: a[:, c], forest), bins,
-                    shrink, float(inits[c]), num_it, depth_cap)
-                    for c in range(nc)]
-                raw = jnp.stack(cols, axis=1)                    # [n, K]
-                if is_rf:
+        def finalize(raw, mask, num_it):
+            if is_rf:
+                if nc > 1:
                     raw = ((raw - inits[None, :])
                            / jnp.maximum(num_it, 1) + inits[None, :])
-                out = raw if raw_score else obj.transform(raw)
-                return out * mask[:, None]
-            raw = predict_forest_binned(
-                forest, bins, shrink, float(inits[0]), num_it, depth_cap)
-            if is_rf:
-                raw = ((raw - inits[0]) / jnp.maximum(num_it, 1)
-                       + inits[0])
+                else:
+                    raw = ((raw - inits[0]) / jnp.maximum(num_it, 1)
+                           + inits[0])
             out = raw if raw_score else obj.transform(raw)
-            return out * mask
+            return out * (mask[:, None] if nc > 1 else mask)
+
+        if route == "tp":
+            from .mesh import tp_raw_margins
+
+            tp_forest, tp_scale, t_loc = self._tp_parts()
+            raw_fn = tp_raw_margins(
+                self.mesh, tp_forest, tp_scale, t_loc, shrink,
+                depth_cap, num_class=nc, widen=quantized)
+
+            def fn(bins, mask, num_it):
+                raw = raw_fn(bins, num_it) + (
+                    inits[None, :] if nc > 1 else inits[0])
+                return finalize(raw, mask, num_it)
+        else:
+            def fn(bins, mask, num_it):
+                f = widen_tree(forest, leaf_scale) if quantized \
+                    else forest
+                if nc > 1:
+                    cols = [predict_forest_binned(
+                        jax.tree.map(lambda a, c=c: a[:, c], f), bins,
+                        shrink, float(inits[c]), num_it, depth_cap)
+                        for c in range(nc)]
+                    raw = jnp.stack(cols, axis=1)                # [n, K]
+                else:
+                    raw = predict_forest_binned(
+                        f, bins, shrink, float(inits[0]), num_it,
+                        depth_cap)
+                return finalize(raw, mask, num_it)
+
+            if route == "dp":
+                from .mesh import dp_shard
+
+                fn = dp_shard(self.mesh, fn)
 
         donate = (0,) if self._donate else ()
         return jax.jit(fn, donate_argnums=donate)

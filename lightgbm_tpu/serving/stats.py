@@ -73,6 +73,7 @@ class ServingStats:
         self.timeouts = 0            # requests expired before dispatch
         self.sheds = 0               # admission-control Overloaded rejects
         self.fallbacks = 0           # graceful-degradation CPU predicts
+        self.route_dispatches: Dict[str, int] = {}  # single/dp/tp counts
         self.queue_latencies = deque(maxlen=RESERVOIR)
         self._cache_info = None      # zero-arg callable set by the runtime
 
@@ -94,13 +95,15 @@ class ServingStats:
 
     # -- runtime-side ------------------------------------------------------
     def record_dispatch(self, bucket: int, rows: int, padded: int,
-                        latency_s: float) -> None:
+                        latency_s: float, route: str = "single") -> None:
         with self._lock:
             bs = self._b(bucket)
             bs.rows += rows
             bs.dispatches += 1
             bs.padded_rows += padded
             bs.latencies.append(latency_s)
+            self.route_dispatches[route] = \
+                self.route_dispatches.get(route, 0) + 1
 
     def record_cache(self, bucket: int, hit: bool) -> None:
         with self._lock:
@@ -141,6 +144,7 @@ class ServingStats:
                 "timeouts": self.timeouts,
                 "sheds": self.sheds,
                 "fallbacks": self.fallbacks,
+                "route_dispatches": dict(self.route_dispatches),
                 "queue_latency_p50_ms": _ms(_quantile(self.queue_latencies,
                                                       0.50)),
                 "queue_latency_p99_ms": _ms(_quantile(self.queue_latencies,

@@ -1,0 +1,475 @@
+"""Pod-scale serving: mesh-sharded prediction + quantized PackedForest.
+
+Covers the r14 acceptance surface: the deterministic route chooser and
+its dp row-tile floor, dp bit-identity vs the single-device runtime
+across batch shapes (ragged tails included) on the virtual CPU mesh, tp
+``psum`` parity within a few ulp (with ``num_iteration`` truncation and
+multiclass), warm() coverage of shard programs (zero traffic-path
+compiles), the shared quantizer (wire shim re-exports, exact
+threshold-bound guards, per-tree int8 scales, models-per-byte gains),
+the two-gate quantized canary, and the r12 chaos matrix re-run with the
+mesh active: hot swap, rollback, device-fault fallback and the CLI
+SIGTERM drain — all with mesh/precision serve keys.
+
+Mesh programs compile against the 8 virtual CPU devices conftest forces
+via ``xla_force_host_platform_device_count``; models stay tiny because
+shard_map compiles dominate wall time here.
+"""
+
+import io
+import json
+import signal
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops import quantize as qz
+from lightgbm_tpu.serving import (
+    FaultInjector,
+    MicroBatcher,
+    ModelBank,
+    PackedForest,
+    PredictorRuntime,
+    SwapRejected,
+    ThresholdBoundError,
+    pack_booster,
+)
+from lightgbm_tpu.serving.mesh import (
+    DP_MIN_ROWS_PER_SHARD,
+    ServingMesh,
+    choose_route,
+)
+
+
+# ---------------------------------------------------------------------------
+# fixtures (tiny models, small buckets: shard_map compiles dominate)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def mesh_models(small_regression, tmp_path_factory):
+    """(X, v1_path, v2_path): two same-feature-count regression models
+    with different predictions, saved as serving artifacts."""
+    X, y = small_regression
+    d = tmp_path_factory.mktemp("mesh")
+    b1 = lgb.train(
+        {"objective": "regression", "num_leaves": 15, "verbosity": -1},
+        lgb.Dataset(X, label=y), num_boost_round=12)
+    b2 = lgb.train(
+        {"objective": "regression", "num_leaves": 7, "verbosity": -1},
+        lgb.Dataset(X, label=np.asarray(X[:, 0], np.float64)),
+        num_boost_round=4)
+    v1, v2 = str(d / "v1.npz"), str(d / "v2.npz")
+    pack_booster(b1).save(v1)
+    pack_booster(b2).save(v2)
+    return X, v1, v2
+
+
+@pytest.fixture(scope="module")
+def mc_packed():
+    rng = np.random.default_rng(7)
+    n = 600
+    X = rng.normal(size=(n, 4))
+    y = ((X[:, 0] + X[:, 1] > 0).astype(int)
+         + (X[:, 2] > 0.5).astype(int)).astype(np.float64)
+    b = lgb.train(
+        {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+         "verbosity": -1},
+        lgb.Dataset(X, label=y), num_boost_round=4)
+    return X, pack_booster(b)
+
+
+@pytest.fixture(scope="module")
+def binary_packed(small_binary):
+    X, y = small_binary
+    b = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "verbosity": -1},
+        lgb.Dataset(X, label=y), num_boost_round=15)
+    return X, y, pack_booster(b)
+
+
+def _ulp_tol(ref, ulps=2):
+    return ulps * np.spacing(np.float32(np.max(np.abs(ref))))
+
+
+# ---------------------------------------------------------------------------
+# route chooser + mesh validation (pure functions, no compiles)
+# ---------------------------------------------------------------------------
+def test_choose_route_matrix():
+    floor = DP_MIN_ROWS_PER_SHARD
+    # one device: always single, whatever the policy asks for
+    for pol in ("auto", "dp", "tp"):
+        assert choose_route(pol, 256, 500, 1) == "single"
+    # dp engages only at a full row tile per shard
+    assert choose_route("dp", 4 * floor, 100, 4) == "dp"
+    assert choose_route("dp", 4 * floor - 1, 100, 4) == "single"
+    assert choose_route("dp", 2 * floor, 100, 4) == "single"
+    # tp needs a tree per device
+    assert choose_route("tp", 8, 100, 8) == "tp"
+    assert choose_route("tp", 8, 7, 8) == "single"
+    # auto: small bucket + splittable forest -> tp; big bucket -> dp;
+    # neither -> single
+    assert choose_route("auto", 16, 100, 4) == "tp"
+    assert choose_route("auto", 256, 100, 4) == "dp"
+    assert choose_route("auto", 16, 4, 4) == "single"
+    # auto never picks dp below the tile floor (and won't promote a
+    # 4-tree forest to tp either)
+    assert choose_route("auto", 64, 4, 8) == "single"
+    assert choose_route("auto", 8 * floor, 4, 8) == "dp"
+    with pytest.raises(ValueError, match="shard_policy"):
+        choose_route("both", 64, 100, 4)
+
+
+def test_mesh_and_runtime_validation(mesh_models):
+    _, v1, _ = mesh_models
+    with pytest.raises(ValueError, match="power of two"):
+        ServingMesh(3)
+    pf = PackedForest.load(v1)
+    with pytest.raises(ValueError, match="power of two"):
+        PredictorRuntime(pf, mesh_devices=3)
+    with pytest.raises(ValueError, match="shard_policy"):
+        PredictorRuntime(pf, mesh_devices=2, shard_policy="maybe")
+    with pytest.raises(ValueError, match="forest_precision"):
+        PredictorRuntime(pf, forest_precision="fp4")
+
+
+# ---------------------------------------------------------------------------
+# dp: bit-identity vs the single-device runtime
+# ---------------------------------------------------------------------------
+def test_dp_bit_identical_across_shapes(mesh_models):
+    X, v1, _ = mesh_models
+    pf = PackedForest.load(v1)
+    single = PredictorRuntime(pf, max_bucket=256)
+    for d in (2, 4):
+        rt = PredictorRuntime(pf, max_bucket=256, mesh_devices=d,
+                              shard_policy="dp")
+        for n in (1, 17, 16 * d, 137):       # ragged tails + exact tile
+            got = rt.predict(X[:n])
+            assert np.array_equal(got, single.predict(X[:n])), (d, n)
+        assert "dp" in rt.cache_info()["routes_live"]
+
+
+def test_dp_bit_identical_d8_and_num_iteration(mesh_models):
+    X, v1, _ = mesh_models
+    pf = PackedForest.load(v1)
+    single = PredictorRuntime(pf, max_bucket=256)
+    rt = PredictorRuntime(pf, max_bucket=256, mesh_devices=8,
+                          shard_policy="dp")
+    assert rt.route_for(256) == "dp" and rt.route_for(64) == "single"
+    for k in (None, 5):
+        got = rt.predict(X[:137], num_iteration=k)
+        assert np.array_equal(got, single.predict(X[:137],
+                                                  num_iteration=k))
+
+
+def test_dp_multiclass_bit_identical(mc_packed):
+    X, pf = mc_packed
+    single = PredictorRuntime(pf, max_bucket=128)
+    rt = PredictorRuntime(pf, max_bucket=128, mesh_devices=4,
+                          shard_policy="dp")
+    got = rt.predict(X[:97])
+    assert got.shape == (97, 3)
+    assert np.array_equal(got, single.predict(X[:97]))
+
+
+# ---------------------------------------------------------------------------
+# tp: psum parity within a few ulp
+# ---------------------------------------------------------------------------
+def test_tp_parity_within_ulp(mesh_models):
+    X, v1, _ = mesh_models
+    pf = PackedForest.load(v1)
+    single = PredictorRuntime(pf, max_bucket=64)
+    rt = PredictorRuntime(pf, max_bucket=64, mesh_devices=4,
+                          shard_policy="tp")
+    ref = single.predict(X[:16])
+    got = rt.predict(X[:16])
+    assert np.max(np.abs(got - ref)) <= _ulp_tol(ref)
+    assert rt.cache_info()["routes_live"] == ["tp"]
+
+
+def test_tp_truncation_window(mesh_models):
+    """tp maps the global ``num_iteration`` window into local tree
+    coordinates per shard — truncated replay must match single-device
+    truncation, not silently use the full forest."""
+    X, v1, _ = mesh_models
+    pf = PackedForest.load(v1)
+    single = PredictorRuntime(pf, max_bucket=32)
+    rt = PredictorRuntime(pf, max_bucket=32, mesh_devices=4,
+                          shard_policy="tp")
+    for k in (1, 5, pf.num_trees):
+        ref = single.predict(X[:8], num_iteration=k)
+        got = rt.predict(X[:8], num_iteration=k)
+        assert np.max(np.abs(got - ref)) <= _ulp_tol(ref), k
+
+
+def test_tp_multiclass_parity(mc_packed):
+    X, pf = mc_packed
+    single = PredictorRuntime(pf, max_bucket=32)
+    rt = PredictorRuntime(pf, max_bucket=32, mesh_devices=2,
+                          shard_policy="tp")
+    ref = single.predict(X[:8])
+    got = rt.predict(X[:8])
+    assert np.max(np.abs(got - ref)) <= _ulp_tol(ref)
+
+
+# ---------------------------------------------------------------------------
+# warm coverage: zero traffic-path compiles with shard routes live
+# ---------------------------------------------------------------------------
+def test_warm_covers_shard_programs(mesh_models):
+    X, v1, _ = mesh_models
+    rt = PredictorRuntime(PackedForest.load(v1), max_bucket=128,
+                          mesh_devices=4, shard_policy="dp")
+    rt.warm()
+    info0 = rt.cache_info()
+    assert info0["shard_programs"] > 0
+    for n in (3, 64, 100):                # single + dp routes
+        rt.predict(X[:n])
+    info1 = rt.cache_info()
+    assert info1["num_compiles"] == info0["num_compiles"]
+    assert info1["mesh_devices"] == 4
+    snap = rt.stats.snapshot()
+    assert snap["compile_cache"]["shard_programs"] == info1[
+        "shard_programs"]
+    assert snap["route_dispatches"].get("dp", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# shared quantizer: wire shim, guards, scales, byte gains
+# ---------------------------------------------------------------------------
+def test_wire_shim_reexports_shared_quantizer():
+    from lightgbm_tpu.ops import histogram
+
+    assert histogram._wire_transfer is qz.wire_transfer
+    assert histogram.WIRE_DTYPES is qz.WIRE_DTYPES
+    assert qz.WIRE_DTYPES == ("f32", "bf16", "int8")
+
+
+def test_quantize_forest_scales_and_bound(mesh_models):
+    _, v1, _ = mesh_models
+    pf = PackedForest.load(v1)
+    q = qz.quantize_forest(pf.split_feature, pf.split_bin, pf.left,
+                           pf.right, pf.leaf_value, pf.is_leaf, "int8")
+    deq = q.dequantized_leaf_values()
+    real = np.where(pf.is_leaf, pf.leaf_value, 0.0)
+    err = np.abs(np.where(pf.is_leaf, deq, 0.0) - real)
+    # per-tree symmetric scales: every leaf within half a quantum
+    assert np.all(err <= 0.5 * q.leaf_scale[:, None] + 1e-12)
+    # the advertised bound dominates the worst per-row sum of errors
+    assert q.error_bound >= float(np.max(np.sum(err, axis=-1))) - 1e-12
+    assert q.leaf_q.dtype == np.int8
+
+
+def test_quantize_threshold_bound_hard_error(mesh_models):
+    _, v1, _ = mesh_models
+    pf = PackedForest.load(v1)
+    bad_bin = pf.split_bin.copy()
+    bad_bin[0, int(np.argmin(pf.is_leaf[0]))] = 300
+    with pytest.raises(ThresholdBoundError, match="split_bin"):
+        qz.quantize_forest(pf.split_feature, bad_bin, pf.left, pf.right,
+                           pf.leaf_value, pf.is_leaf, "int8")
+
+
+def test_models_per_byte_gains():
+    assert qz.models_per_byte_gain("int8") >= 1.9
+    assert qz.models_per_byte_gain("bf16") >= 1.5
+    f32 = qz.packed_model_bytes(200, 509, precision="f32")
+    i8 = qz.packed_model_bytes(200, 509, precision="int8")
+    assert f32 / i8 >= 1.9
+
+
+# ---------------------------------------------------------------------------
+# quantized runtime: drift bounded by its own arithmetic bound + AUC
+# ---------------------------------------------------------------------------
+def _auc(y, s):
+    y = np.asarray(y, bool)
+    order = np.argsort(s, kind="mergesort")
+    ranks = np.empty(len(s), np.float64)
+    ss = np.asarray(s, np.float64)[order]
+    i = 0
+    while i < len(s):
+        j = i
+        while j + 1 < len(s) and ss[j + 1] == ss[i]:
+            j += 1
+        ranks[order[i:j + 1]] = 0.5 * ((i + 1) + (j + 1))
+        i = j + 1
+    n_pos = int(y.sum())
+    return float((ranks[y].sum() - n_pos * (n_pos + 1) / 2.0)
+                 / (n_pos * (len(y) - n_pos)))
+
+
+def test_int8_margin_drift_and_auc(binary_packed):
+    X, y, pf = binary_packed
+    n = 1000
+    ref = PredictorRuntime(pf, max_bucket=256).predict(
+        X[:n], raw_score=True)
+    for prec in ("bf16", "int8"):
+        rt = PredictorRuntime(pf, max_bucket=256, forest_precision=prec)
+        got = rt.predict(X[:n], raw_score=True)
+        assert np.max(np.abs(got - ref)) <= rt.quant_error_bound, prec
+        assert abs(_auc(y[:n], got) - _auc(y[:n], ref)) <= 1e-4, prec
+        # degraded-mode fallback answers come from the dequantized
+        # oracle, i.e. they match device arithmetic, not exact f32
+        oracle = rt.oracle.predict_numpy(
+            rt.packed.bin_mapper.transform(np.asarray(X[:8], np.float64)),
+            raw_score=True)
+        dev = rt.predict(X[:8], raw_score=True)
+        assert np.max(np.abs(dev - oracle)) <= 1e-5, prec
+
+
+def test_quantized_dp_matches_quantized_single(binary_packed):
+    X, _, pf = binary_packed
+    single = PredictorRuntime(pf, max_bucket=128, forest_precision="int8")
+    rt = PredictorRuntime(pf, max_bucket=128, mesh_devices=4,
+                          shard_policy="dp", forest_precision="int8")
+    assert np.array_equal(rt.predict(X[:128]), single.predict(X[:128]))
+
+
+# ---------------------------------------------------------------------------
+# chaos matrix with the mesh active (the r12 contracts must survive)
+# ---------------------------------------------------------------------------
+def _mesh_bank(**kw):
+    kw.setdefault("max_bucket", 128)
+    kw.setdefault("canary_rows", 4)
+    kw.setdefault("mesh_devices", 4)
+    kw.setdefault("shard_policy", "dp")
+    return ModelBank(**kw)
+
+
+def test_bank_quantized_canary_two_gates(mesh_models):
+    _, v1, _ = mesh_models
+    bank = _mesh_bank(forest_precision="int8", warm_on_deploy=False)
+    rep = bank.deploy("m", v1)
+    assert rep["canary"]["quant_abs_err"] <= rep["canary"][
+        "quant_error_bound"]
+
+
+def test_bank_threshold_bound_rejected_at_build(mesh_models, tmp_path):
+    import copy
+
+    _, v1, _ = mesh_models
+    bad = copy.deepcopy(PackedForest.load(v1))
+    bad.split_bin = bad.split_bin.astype(np.int32)
+    bad.split_bin[0, int(np.argmin(bad.is_leaf[0]))] = 300
+    bad_path = str(tmp_path / "bad_bin.npz")
+    bad.save(bad_path)
+    bank = ModelBank(max_bucket=32, canary_rows=4, warm_on_deploy=False,
+                     forest_precision="int8")
+    with pytest.raises(SwapRejected, match="build"):
+        bank.deploy("m", bad_path)
+
+
+def test_mesh_hot_swap_atomic_for_queued_traffic(mesh_models):
+    X, v1, v2 = mesh_models
+    bank = _mesh_bank(warm_on_deploy=False)
+    bank.deploy("m", v1)
+    t = [0.0]
+    mb = bank.batcher("m", max_batch=4, max_delay_ms=5.0,
+                      clock=lambda: t[0])
+    v1_single = PredictorRuntime(PackedForest.load(v1), max_bucket=128)
+    v2_single = PredictorRuntime(PackedForest.load(v2), max_bucket=128)
+    pre = [mb.submit(X[i]) for i in range(3)]
+    bank.deploy("m", v2)                  # swap with requests queued
+    post = [mb.submit(X[i]) for i in range(3)]
+    t[0] += 1.0
+    mb.pump(); mb.flush()
+    got = np.array([h.result() for h in pre + post])
+    want_v2 = v2_single.predict(X[:3])
+    # queued traffic resolves the bank at DISPATCH: one atomic flip
+    # moved every device's programs to v2, nothing failed or forked
+    assert np.array_equal(got[3:], want_v2)
+    assert all(np.array_equal(g, a) or np.array_equal(g, b)
+               for g, a, b in zip(got[:3], v1_single.predict(X[:3]),
+                                  want_v2))
+
+
+def test_mesh_rollback_bit_identical(mesh_models, tmp_path):
+    import copy
+
+    X, v1, v2 = mesh_models
+    bank = _mesh_bank(warm_on_deploy=False)
+    bank.deploy("m", v1)
+    before = bank.predict("m", X[:64])
+    bank.deploy("m", v2)
+    bad = copy.deepcopy(PackedForest.load(v1))
+    bad.left[0, 0] = 0                    # cycle -> ingest rejection
+    bad_path = str(tmp_path / "cycle.npz")
+    bad.save(bad_path)
+    with pytest.raises(SwapRejected, match="ingest"):
+        bank.deploy("m", bad_path)
+    assert bank.version("m") == "v2"
+    rb = bank.rollback("m")
+    assert rb["version"] == "v1"
+    assert np.array_equal(bank.predict("m", X[:64]), before)
+
+
+def test_mesh_device_fault_falls_back_to_oracle(mesh_models):
+    X, v1, _ = mesh_models
+    bank = _mesh_bank(warm_on_deploy=False, forest_precision="int8")
+    bank.deploy("m", v1)
+    rt = bank.runtime("m")
+    inj = FaultInjector()
+    inj.arm("device_predict", after=0, times=1, message="mesh boom")
+    rt.faults = inj
+    t = [0.0]
+    mb = bank.batcher("m", max_batch=4, max_delay_ms=5.0,
+                      clock=lambda: t[0])
+    handles = [mb.submit(X[i]) for i in range(4)]
+    mb.pump(); mb.flush()
+    got = np.array([h.result() for h in handles])
+    # degraded answers come from the dequantized oracle — the same
+    # arithmetic the device route serves, so the fallback is seamless
+    want = rt.oracle.predict_numpy(
+        rt.packed.bin_mapper.transform(np.asarray(X[:4], np.float64)),
+        raw_score=False)
+    assert np.allclose(got, want, atol=1e-6)
+    assert mb.stats.snapshot()["fallbacks"] > 0
+
+
+# ---------------------------------------------------------------------------
+# CLI serve keys + SIGTERM drain with the mesh active
+# ---------------------------------------------------------------------------
+def _run_serve(path, cfg, lines):
+    from lightgbm_tpu.__main__ import _serve
+
+    out, err = io.StringIO(), io.StringIO()
+    rc = _serve(path, dict(cfg), stdin=iter(lines), stdout=out,
+                stderr=err)
+    return rc, out.getvalue().splitlines(), err.getvalue()
+
+
+def test_cli_serve_rejects_bad_mesh_keys(mesh_models):
+    from lightgbm_tpu.__main__ import _serve
+
+    _, v1, _ = mesh_models
+    for cfg, msg in (
+            ({"mesh_devices": "3"}, "mesh_devices"),
+            ({"mesh_devices": "lots"}, "mesh_devices"),
+            ({"shard_policy": "sometimes"}, "shard_policy"),
+            ({"forest_precision": "fp4"}, "forest_precision"),
+    ):
+        with pytest.raises(SystemExit, match=msg):
+            _serve(v1, cfg, stdin=iter(()), stdout=io.StringIO(),
+                   stderr=io.StringIO())
+
+
+def test_cli_serve_mesh_sigterm_drains(mesh_models):
+    """SIGTERM mid-stream with mesh + int8 active: admitted requests are
+    answered from the sharded quantized runtime, the drain contract is
+    unchanged from r12."""
+    X, v1, _ = mesh_models
+    rows = [",".join(f"{x:.8g}" for x in X[i]) for i in range(3)]
+
+    def feed():
+        yield rows[0] + "\n"
+        yield rows[1] + "\n"
+        signal.raise_signal(signal.SIGTERM)
+        yield rows[2] + "\n"
+    rc, out, err = _run_serve(
+        v1, {"mesh_devices": "4", "shard_policy": "dp",
+             "forest_precision": "int8", "canary_rows": "4"}, feed())
+    assert rc == 0
+    assert len(out) == 2 and "ERROR" not in "".join(out)
+    assert "drained on SIGTERM" in err
+    final = json.loads(err.strip().splitlines()[-1])
+    assert final["requests"] == 2
+    assert final["compile_cache"]["mesh_devices"] == 4
+    assert final["compile_cache"]["forest_precision"] == "int8"

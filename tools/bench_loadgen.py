@@ -1,16 +1,26 @@
-"""Serving load generator: saturation, shedding and fault scenarios.
+"""Serving load generator: saturation, shedding, fault and mesh scenarios.
 
 Drives the resilient serving stack (ModelBank + admission-controlled
 MicroBatcher) through open- and closed-loop request streams, mixed batch
 sizes and deterministic fault injections, and records p50/p99/p99.9
-latency, deadline-miss rate and shed rate into ``BENCH_SERVE_r12.json``
-together with the ``acceptance_r12`` rollup the r12 issue gates on:
+latency, deadline-miss rate and shed rate into ``BENCH_SERVE_r14.json``
+together with the ``acceptance_r12`` rollup (the r12 resilience bar, kept
+green) and the ``acceptance_r14`` rollup the pod-scale issue gates on:
 
-* closed-loop saturation with ONE injected device fault keeps the
-  deadline-miss rate <= 1% while shedding is active (shed before miss);
-* a hot swap under load flips with ZERO failed in-flight requests;
-* rollback (after corrupt-artifact swap rejections) restores the prior
-  version bit-identically.
+* the r12 set — shed-before-miss under saturation and a device fault, a
+  hot swap under load with ZERO failed in-flight requests, bit-identical
+  rollback after corrupt-artifact rejections;
+* r14 multi-device saturation tier — a dp-sharded ModelBank swept over
+  device counts D in {1, 2, 4, 8} on the virtual CPU mesh, closed-loop
+  capacity and open-loop 2x-single-device overload per tier, quoting
+  p50/p99/p99.9 and the QPS multiple vs D=1 (>=3x at D=4, 0 deadline
+  misses at 2x overload), with dp outputs pinned bit-identical to the
+  single-device baseline at every tier;
+* r14 quantized PackedForest — int8 margins on a binary task gated at
+  <=1e-4 AUC drift vs f32, >=1.9x resident models per HBM byte, and a
+  HARD ``SwapRejected`` on a threshold-bound violation;
+* r14 mesh resilience — the r12 hot-swap and rollback scenarios re-run
+  with the mesh active (swaps are mesh-wide atomic).
 
 Queueing dynamics run on a SIM CLOCK for determinism: the batcher, its
 deadlines and its EWMA wait predictor all read an advancing virtual
@@ -19,7 +29,11 @@ time into it (calibrated per host with real ``perf_counter`` timings, so
 the operating point is honest; charging the median instead of each
 dispatch's jitter keeps the shed/miss accounting reproducible).  Real
 wall-clock dispatch latencies are reported separately by the mixed-size
-direct scenario.
+direct scenario.  Mesh tiers charge the ``serve_mesh_dispatch_model``
+sharded dispatch time derived from the same calibration — the virtual
+CPU mesh executes the REAL shard_map programs for correctness while the
+clock carries the device-count scaling model; the artifact marks this
+provenance explicitly (``virtual_mesh_cpu_proxy_sim_clock``).
 
 A deadline MISS counts both requests that expired in queue
 (``RequestTimeout`` — the queue's own counter) and requests served after
@@ -41,9 +55,16 @@ import numpy as np
 sys.path.insert(0, ".")
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the mesh tier needs the virtual 8-device CPU backend — must land
+# before jax initializes
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import lightgbm_tpu as lgb
 from lightgbm_tpu.analysis.budgets import (check_serve_slo_budgets,
+                                           serve_mesh_dispatch_model,
                                            serve_queue_model)
 from lightgbm_tpu.serving import (FaultInjector, MicroBatcher, ModelBank,
                                   Overloaded, RequestTimeout, SwapRejected,
@@ -51,6 +72,7 @@ from lightgbm_tpu.serving import (FaultInjector, MicroBatcher, ModelBank,
 
 MAX_BATCH = 64
 MAX_BUCKET = 256
+MESH_DEVICES = (1, 2, 4, 8)
 EPS = 1e-9
 
 
@@ -414,11 +436,195 @@ def corrupt_artifacts(packed, tmpdir):
     return out
 
 
+def auc_score(y, s) -> float:
+    """Mann-Whitney AUC with average ranks for ties (quantized margins
+    DO tie, so the tie handling is load-bearing)."""
+    y = np.asarray(y, bool)
+    s = np.asarray(s, np.float64).ravel()
+    order = np.argsort(s, kind="mergesort")
+    ranks = np.empty(len(s), np.float64)
+    ss = s[order]
+    i = 0
+    while i < len(s):
+        j = i
+        while j + 1 < len(s) and ss[j + 1] == ss[i]:
+            j += 1
+        ranks[order[i:j + 1]] = 0.5 * ((i + 1) + (j + 1))
+        i = j + 1
+    n_pos = int(y.sum())
+    n_neg = len(y) - n_pos
+    return float((ranks[y].sum() - n_pos * (n_pos + 1) / 2.0)
+                 / (n_pos * n_neg))
+
+
+def mesh_bank(v1_path, d, *, policy="dp", precision="f32", warm=True,
+              raw_score=False, name="m"):
+    bank = ModelBank(max_bucket=MAX_BUCKET, max_cache_entries=16,
+                     warm_on_deploy=warm, canary_rows=8,
+                     mesh_devices=d, shard_policy=policy,
+                     forest_precision=precision)
+    bank.deploy(name, v1_path, raw_score=raw_score)
+    return bank
+
+
+def scenario_mesh_tier(v1_path, rows, probe, dispatch_ms, baselines,
+                       n_requests=2000):
+    """r14 multi-device saturation sweep.  For each device count D the
+    dp-sharded bank executes the REAL shard_map programs on the virtual
+    CPU mesh (correctness: bit-identity vs the single-device baseline,
+    warm coverage of shard programs); the sim clock charges the
+    ``serve_mesh_dispatch_model`` sharded dispatch time derived from the
+    calibrated single-device median (timing: D-scaling is the validated
+    analytical model, not a CPU-proxy wall clock — the artifact's
+    provenance field says so).  Two operating points per tier: a
+    closed-loop capacity probe (QPS multiple vs D=1) and an open-loop
+    stream offered at 2x the SINGLE-device capacity (the overload the
+    acceptance gate pins to zero deadline misses at D=4)."""
+    cap1 = MAX_BATCH / (dispatch_ms / 1e3)
+    ragged = np.stack([rows[i % len(rows)] for i in range(137)])
+    tiers = []
+    qps_d1 = None
+    for d in MESH_DEVICES:
+        model = serve_mesh_dispatch_model(d, dispatch_ms, bucket=MAX_BATCH)
+        charge_ms = model["dispatch_ms_sharded"]
+        bank = mesh_bank(v1_path, d)
+        rt = bank.runtime("m")
+        info0 = rt.cache_info()
+        got_probe = bank.predict("m", probe)
+        got_ragged = bank.predict("m", ragged)
+        info1 = rt.cache_info()
+        bit_identical = (np.array_equal(got_probe, baselines["probe"])
+                         and np.array_equal(got_ragged,
+                                            baselines["ragged"]))
+
+        deadline_ms = 6.0 * dispatch_ms
+        clock = SimClock()
+        b = make_batcher(bank, "m", clock, deadline_ms, charge_ms,
+                         "deadline")
+        t0 = clock()
+        rec = run_closed_loop(b, clock, rows, n_requests,
+                              concurrency=32 * MAX_BATCH,
+                              deadline_ms=deadline_ms)
+        closed = rec.summary()
+        span = clock() - t0
+        closed["qps"] = rec.ok / span if span > 0 else 0.0
+        if d == 1:
+            qps_d1 = closed["qps"]
+        closed["qps_x_vs_d1"] = round(closed["qps"] / qps_d1, 3)
+
+        clock2 = SimClock()
+        b2 = make_batcher(bank, "m", clock2, deadline_ms, charge_ms,
+                          "deadline")
+        rec2 = run_open_loop(b2, clock2, rows, n_requests,
+                             rps=2.0 * cap1, deadline_ms=deadline_ms)
+        overload = rec2.summary()
+        overload["offered_x_single_device_capacity"] = 2.0
+
+        tiers.append({
+            "devices": d,
+            "route": "dp" if d > 1 else "single",
+            "dispatch_model": model,
+            "charge_ms": charge_ms,
+            "dp_bit_identical": bool(bit_identical),
+            "shard_programs_warmed": info0["shard_programs"],
+            "zero_compiles_after_warm":
+                info1["num_compiles"] == info0["num_compiles"],
+            "closed_capacity": closed,
+            "open_2x_single_device": overload,
+        })
+        print(f"mesh tier d={d}: qps_x={closed['qps_x_vs_d1']} "
+              f"overload misses={overload['deadline_misses']} "
+              f"sheds={overload['sheds']} bit_identical={bit_identical}",
+              flush=True)
+    return {"device_counts": list(MESH_DEVICES),
+            "single_device_capacity_rps": cap1,
+            "timing": "virtual_mesh_cpu_proxy_sim_clock",
+            "tiers": tiers}
+
+
+def scenario_quantized(tmpdir):
+    """r14 quantized PackedForest gates on a binary MARGIN task: int8
+    and bf16 raw margins vs the f32 reference — per-precision AUC drift
+    (int8 bar: <=1e-4), device-vs-oracle canary numbers from the deploy
+    report, resident models-per-HBM-byte multiple, and the HARD
+    ``SwapRejected`` a threshold-bound violation must produce at build
+    (never a silently wrapped forest)."""
+    rng = np.random.default_rng(7)
+    n = 6000
+    Xb = rng.standard_normal((n, 10)).astype(np.float32)
+    logit = (1.5 * Xb[:, 0] - Xb[:, 1] + 0.5 * Xb[:, 2] * Xb[:, 3])
+    yb = (logit + 0.5 * rng.standard_normal(n) > 0).astype(np.float64)
+    booster = lgb.train(
+        {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+         "learning_rate": 0.1},
+        lgb.Dataset(Xb[:4000], label=yb[:4000]), num_boost_round=80)
+    pb = pack_booster(booster)
+    path = os.path.join(tmpdir, "binary_margin.npz")
+    pb.save(path)
+    Xe, ye = Xb[4000:], yb[4000:]
+
+    def margins(bank):
+        return np.concatenate([
+            bank.predict("b", Xe[lo:lo + MAX_BUCKET], raw_score=True)
+            for lo in range(0, len(Xe), MAX_BUCKET)])
+
+    out = {"task": "binary_margin", "eval_rows": int(len(Xe)),
+           "trees": pb.num_trees}
+    ref_bank = mesh_bank(path, 4, policy="auto", precision="f32",
+                         warm=False, raw_score=True, name="b")
+    ref = margins(ref_bank)
+    auc_ref = auc_score(ye, ref)
+    nbytes_f32 = ref_bank.runtime("b").forest_nbytes
+    out["f32"] = {"auc": auc_ref, "forest_nbytes": nbytes_f32}
+    for prec in ("bf16", "int8"):
+        bank = ModelBank(max_bucket=MAX_BUCKET, max_cache_entries=16,
+                         warm_on_deploy=False, canary_rows=8,
+                         mesh_devices=4, shard_policy="auto",
+                         forest_precision=prec)
+        rep = bank.deploy("b", path, raw_score=True)
+        got = margins(bank)
+        rt = bank.runtime("b")
+        out[prec] = {
+            "auc": auc_score(ye, got),
+            "auc_drift": abs(auc_score(ye, got) - auc_ref),
+            "max_abs_margin_err": float(np.max(np.abs(got - ref))),
+            "quant_error_bound": rt.quant_error_bound,
+            "canary": {k: rep["canary"][k]
+                       for k in ("quant_abs_err", "quant_error_bound")},
+            "forest_nbytes": rt.forest_nbytes,
+            "models_per_byte_x": round(nbytes_f32 / rt.forest_nbytes, 4),
+        }
+        print(f"quantized {prec}: auc_drift={out[prec]['auc_drift']:.2e} "
+              f"models_per_byte_x={out[prec]['models_per_byte_x']}",
+              flush=True)
+
+    # threshold-bound violation: an artifact whose bin codes exceed the
+    # uint8 wire range must HARD-fail the int8 build, not clamp
+    import copy
+    bad = copy.deepcopy(pb)
+    bad.split_bin = bad.split_bin.astype(np.int32)
+    bad.split_bin[0, int(np.argmin(pb.is_leaf[0]))] = 300
+    bad_path = os.path.join(tmpdir, "threshold_bound.npz")
+    bad.save(bad_path)
+    bad_bank = ModelBank(max_bucket=MAX_BUCKET, warm_on_deploy=False,
+                         canary_rows=8, forest_precision="int8")
+    try:
+        bad_bank.deploy("bad", bad_path, raw_score=True)
+        out["threshold_bound"] = {"rejected": False}
+    except SwapRejected as e:
+        out["threshold_bound"] = {"rejected": True, "stage": e.stage,
+                                  "error": str(e)}
+    out["threshold_bound_rejected"] = (
+        out["threshold_bound"]["rejected"]
+        and out["threshold_bound"].get("stage") == "build")
+    return out
+
+
 def main():
     import jax
 
     n_trees = int(sys.argv[1]) if len(sys.argv) > 1 else 60
-    out_path = sys.argv[2] if len(sys.argv) > 2 else "BENCH_SERVE_r12.json"
+    out_path = sys.argv[2] if len(sys.argv) > 2 else "BENCH_SERVE_r14.json"
 
     booster, X = build_model(n_trees)
     packed = pack_booster(booster)
@@ -468,6 +674,24 @@ def main():
     scenarios["rollback_corrupt_artifacts"] = scenario_rollback(
         bank, "m", probe, v1_baseline, corrupt_artifacts(packed, tmpdir))
 
+    # --- r14: pod-scale tier -------------------------------------------
+    ragged = np.stack([rows[i % len(rows)] for i in range(137)])
+    # single-device f32 reference for the ragged shape, from a fresh v1
+    # bank (the main bank is on v2 after the hot-swap scenario)
+    ref_bank = mesh_bank(v1_path, 1)
+    baselines = {"probe": ref_bank.predict("m", probe),
+                 "ragged": ref_bank.predict("m", ragged)}
+    scenarios["mesh_saturation_tier"] = scenario_mesh_tier(
+        v1_path, rows, probe, dispatch_ms, baselines)
+    scenarios["quantized_packedforest"] = scenario_quantized(tmpdir)
+
+    mb4 = mesh_bank(v1_path, 4)
+    mesh_baseline = mb4.predict("m", probe)
+    scenarios["mesh_hot_swap_under_load"] = scenario_hot_swap(
+        mb4, "m", rows, v2_path, dispatch_ms)
+    scenarios["mesh_rollback_corrupt_artifacts"] = scenario_rollback(
+        mb4, "m", probe, mesh_baseline, corrupt_artifacts(packed, tmpdir))
+
     for k, v in scenarios.items():
         print(f"{k}: {json.dumps(v, default=str)}", flush=True)
 
@@ -497,31 +721,74 @@ def main():
     }
     acceptance["all_green"] = all(acceptance.values())
 
+    tiers = scenarios["mesh_saturation_tier"]["tiers"]
+    t4 = next(t for t in tiers if t["devices"] == 4)
+    qz = scenarios["quantized_packedforest"]
+    msw = scenarios["mesh_hot_swap_under_load"]
+    mrb = scenarios["mesh_rollback_corrupt_artifacts"]
+    acceptance_r14 = {
+        "dp_qps_ge_3x_at_d4":
+            t4["closed_capacity"]["qps_x_vs_d1"] >= 3.0,
+        "zero_deadline_misses_at_2x_overload_d4":
+            t4["open_2x_single_device"]["deadline_misses"] == 0
+            and t4["open_2x_single_device"]["errors"] == 0,
+        "dp_bit_identical_every_tier":
+            all(t["dp_bit_identical"] for t in tiers),
+        "warm_covers_shard_programs":
+            all(t["zero_compiles_after_warm"] for t in tiers)
+            and all(t["shard_programs_warmed"] > 0
+                    for t in tiers if t["devices"] > 1),
+        "int8_auc_drift_le_1e_4": qz["int8"]["auc_drift"] <= 1e-4,
+        "int8_models_per_byte_ge_1p9":
+            qz["int8"]["models_per_byte_x"] >= 1.9,
+        "quant_within_arithmetic_bound": all(
+            qz[p]["canary"]["quant_abs_err"]
+            <= qz[p]["canary"]["quant_error_bound"] + EPS
+            for p in ("bf16", "int8")),
+        "threshold_bound_hard_error": qz["threshold_bound_rejected"],
+        "mesh_hot_swap_zero_failed_inflight":
+            msw["failed_inflight"] == 0 and msw["sheds"] == 0,
+        "mesh_rollback_bit_identical":
+            mrb["all_rejected"]
+            and mrb["serving_bit_identical_after_rejections"]
+            and mrb["rollback_bit_identical"],
+        "slo_budgets_ok": all(r["ok"] for r in slo),
+    }
+    acceptance_r14["all_green"] = all(acceptance_r14.values())
+
     artifact = {
         "bench": "serving_loadgen",
-        "round": 12,
+        "round": 14,
         "backend": jax.default_backend(),
         "model": {"n_trees": packed.num_trees,
                   "n_features": packed.num_feature(),
                   "depth_cap": packed.depth_cap},
         "config": {"max_batch": MAX_BATCH, "max_bucket": MAX_BUCKET,
                    "max_queue_depth": 64 * MAX_BATCH,
-                   "timing": "sim_clock_calibrated_dispatch"},
+                   "timing": "sim_clock_calibrated_dispatch",
+                   "mesh_provenance": "virtual_mesh_cpu_proxy_sim_clock",
+                   "mesh_device_counts": list(MESH_DEVICES)},
         "calibration": {"dispatch_ms": dispatch_ms,
                         "capacity_rps": capacity_rps},
         "queue_model_reference": serve_queue_model(
             2.0 * capacity_rps, dispatch_ms, MAX_BATCH,
             deadline_ms=6.0 * dispatch_ms),
+        "mesh_dispatch_model_reference": {
+            str(d): serve_mesh_dispatch_model(d, dispatch_ms,
+                                              bucket=MAX_BATCH)
+            for d in MESH_DEVICES},
         "scenarios": scenarios,
         "slo_budgets": slo,
         "acceptance_r12": acceptance,
+        "acceptance_r14": acceptance_r14,
     }
     with open(out_path, "w") as f:
         json.dump(artifact, f, indent=2)
         f.write("\n")
-    status = "ALL GREEN" if acceptance["all_green"] else "RED"
-    print(f"wrote {out_path}; acceptance_r12 {status}")
-    return 0 if acceptance["all_green"] else 1
+    green = acceptance["all_green"] and acceptance_r14["all_green"]
+    status = "ALL GREEN" if green else "RED"
+    print(f"wrote {out_path}; acceptance_r12+r14 {status}")
+    return 0 if green else 1
 
 
 if __name__ == "__main__":

@@ -58,7 +58,19 @@ echo "== serving-chaos (fault injection + SLO budgets) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
   -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
-# 6. training-chaos: the r13 recovery surface — checkpoint/resume
+# 6. serving-mesh: the r14 pod-scale surface — dp bit-identity vs the
+#    single-device runtime across batch shapes on the virtual 8-device
+#    mesh, tp psum parity within ulp, the deterministic route chooser,
+#    warm coverage of shard programs, the shared quantizer (wire shim,
+#    threshold-bound hard errors, models-per-byte floors) and the r12
+#    chaos matrix re-run with mesh + int8 active.  The mesh dispatch /
+#    models-per-byte budget models already ran in the graftlint layer
+#    above (serve_slo section).
+echo "== serving-mesh (sharded prediction + quantized forests) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_serving_mesh.py -q \
+  -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# 7. training-chaos: the r13 recovery surface — checkpoint/resume
 #    bit-identity (kill at any round, strict/wave/streamed/dp),
 #    SIGTERM drain, torn/corrupt checkpoint rejection per field,
 #    block-read retry absorption, gradient finiteness screen.  The
@@ -69,7 +81,7 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py \
   tests/test_training_chaos.py -q \
   -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
-# 7. trace-level budgets (slow lane)
+# 8. trace-level budgets (slow lane)
 if [ "$full" = 1 ]; then
   echo "== budgets + recompile sweeps =="
   JAX_PLATFORMS=cpu python -m lightgbm_tpu lint --budgets -q
