@@ -63,6 +63,26 @@ retained (default 2), and ``resume=true|false`` (default true: pick up
 the newest valid checkpoint, bit-identical continuation).  SIGTERM
 finishes the in-flight round, checkpoints, and exits 0, so a preempted
 job resumes by rerunning the same command line.
+
+``task=refresh`` (r15) runs the freshness pipeline: watch a directory
+for ``*.npz`` row-block files (``X`` + ``y`` arrays), continue training
+the live model ``refresh_rounds`` rounds per generation, and push each
+versioned artifact through canary + atomic hot swap, reporting the
+measured model staleness per flip:
+
+    python -m lightgbm_tpu task=refresh watch_dir=blocks/ \
+        state_dir=state/ refresh_rounds=5 staleness_slo_ms=60000 \
+        objective=binary num_leaves=31
+
+Keys (validated up front; unknown keys are rejected like ``serve``):
+``watch_dir``/``state_dir`` (required), ``refresh_rounds`` (default 5),
+``initial_rounds`` (generation 1; defaults to refresh_rounds),
+``checkpoint_rounds`` (default 5), ``canary_rows`` (default 8),
+``staleness_slo_ms`` (optional SLO; breaches are reported on stderr),
+``model_name`` (default "model"), ``max_ticks`` (default 64 — the CLI
+drains the watch directory and exits; schedulers rerun it).  Remaining
+keys are LightGBM training params, checked against the known parameter
+vocabulary.
 """
 
 from __future__ import annotations
@@ -138,7 +158,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .analysis.cli import main as lint_main
 
         return lint_main(raw[1:])
-    cfg = parse_argv(raw)
+    try:
+        cfg = parse_argv(raw)
+    except (ValueError, OSError) as e:
+        # `python -m lightgbm_tpu refresh --help`-style misuse: a typed
+        # usage error, never a traceback
+        raise SystemExit(
+            f"lightgbm_tpu: {e}\nusage: python -m lightgbm_tpu "
+            "task=train|predict|serve|refresh key=value ... "
+            "(or config=<file>; see module docs)") from None
     task = cfg.pop("task", "train")
     header = cfg.pop("header", "false").lower() in ("true", "1", "yes")
     label_spec = cfg.pop("label_column", "0")
@@ -216,7 +244,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             raise SystemExit(
                 "task=serve requires input_model=<model.txt|model.npz>")
         return _serve(input_model, cfg)
-    raise SystemExit(f"unknown task {task!r} (train|predict|serve)")
+    if task == "refresh":
+        return _refresh(cfg)
+    raise SystemExit(
+        f"unknown task {task!r} (train|predict|serve|refresh)")
 
 
 def _parse_request_line(line: str) -> Optional[np.ndarray]:
@@ -437,6 +468,91 @@ def _serve(input_model: str, cfg: Dict[str, str],
     if show_stats or draining:
         stderr.write(json.dumps(stats.snapshot()) + "\n")
         stderr.flush()
+    return 0
+
+
+def _refresh(cfg: Dict[str, str], stdout=None, stderr=None) -> int:
+    """``task=refresh``: drive the r15 freshness pipeline over a watch
+    directory.  Every refresh key is validated up front and unknown
+    keys are rejected (the r12 ``serve`` contract) — a typo'd operating
+    point fails at startup, not mid-refresh; the keys left over after
+    the refresh set must belong to the known LightGBM/TPU parameter
+    vocabulary.  One invocation drains the watch directory (bounded by
+    ``max_ticks``) and exits; schedulers keep the loop alive by
+    rerunning the same command line — the daemon re-anchors on the
+    newest completed artifact in ``state_dir``."""
+    import json
+
+    from .config import _ALIASES, _FRAMEWORK_KEYS
+    from .pipeline import DirectoryFeed, RefreshDaemon
+
+    stdout = sys.stdout if stdout is None else stdout
+    stderr = sys.stderr if stderr is None else stderr
+
+    def die(msg: str) -> "SystemExit":
+        return SystemExit(f"task=refresh: {msg}")
+
+    def intkey(key: str, default: str, minimum: int):
+        raw_v = cfg.pop(key, default)
+        if raw_v is None:
+            return None
+        try:
+            v = int(raw_v)
+        except ValueError:
+            raise die(f"{key} must be an integer, got {raw_v!r}") \
+                from None
+        if v < minimum:
+            raise die(f"{key} must be >= {minimum}, got {v}")
+        return v
+
+    watch_dir = cfg.pop("watch_dir", None)
+    if not watch_dir:
+        raise die("requires watch_dir=<directory of X/y .npz blocks>")
+    state_dir = cfg.pop("state_dir", None)
+    if not state_dir:
+        raise die("requires state_dir=<directory for models/checkpoints>")
+    refresh_rounds = intkey("refresh_rounds", "5", 1)
+    initial_rounds = intkey("initial_rounds", None, 1)
+    checkpoint_rounds = intkey("checkpoint_rounds", "5", 1)
+    canary_rows = intkey("canary_rows", "8", 0)
+    max_ticks = intkey("max_ticks", "64", 1)
+    model_name = cfg.pop("model_name", "model")
+    slo_s = cfg.pop("staleness_slo_ms", None)
+    staleness_slo_ms = None
+    if slo_s is not None:
+        try:
+            staleness_slo_ms = float(slo_s)
+        except ValueError:
+            raise die(f"staleness_slo_ms must be a number, got "
+                      f"{slo_s!r}") from None
+        if staleness_slo_ms <= 0:
+            raise die(f"staleness_slo_ms must be > 0, got "
+                      f"{staleness_slo_ms}")
+    unknown = sorted(k for k in cfg
+                     if k.lower() not in _ALIASES
+                     and k.lower() not in _FRAMEWORK_KEYS)
+    if unknown:
+        raise die(f"unknown key(s): {', '.join(unknown)}")
+
+    daemon = RefreshDaemon(
+        dict(cfg), state_dir, feed=DirectoryFeed(watch_dir),
+        model_name=model_name, refresh_rounds=refresh_rounds,
+        initial_rounds=initial_rounds,
+        checkpoint_rounds=checkpoint_rounds,
+        staleness_slo_ms=staleness_slo_ms, canary_rows=canary_rows)
+    events = daemon.run_until_idle(max_ticks=max_ticks)
+    for ev in events:
+        doc = {k: v for k, v in ev.items() if k != "report"}
+        stdout.write(json.dumps(doc) + "\n")
+    snap = daemon.tracker.snapshot()
+    stderr.write(json.dumps({
+        "generation": daemon.snapshot()["generation"],
+        "served": snap["served"],
+        "worst_staleness_ms": snap["worst_staleness_ms"],
+        "breaches": snap["breaches"],
+    }) + "\n")
+    stdout.flush()
+    stderr.flush()
     return 0
 
 

@@ -1250,3 +1250,169 @@ def check_ckpt_budgets(names: Optional[List[str]] = None
     specs = (CKPT_BUDGETS if names is None
              else [ckpt_budget_by_name(n) for n in names])
     return [b.check() for b in specs]
+
+
+# ---------------------------------------------------------------------------
+# Freshness budgets (ISSUE r15): the model-staleness SLO, decomposed
+#
+# **Model staleness** = seconds from a row block ARRIVING to a model
+# trained on it SERVING traffic.  The refresh pipeline
+# (lightgbm_tpu.pipeline) measures it; this model BOUNDS it offline:
+#
+#     staleness <= wait (daemon tick) + train (refresh_rounds rounds)
+#                + publish (pack + atomic artifact write)
+#                + warm (per-bucket-shape XLA compiles)
+#                + canary (device dispatch + host oracle replay)
+#                + flip (one attribute assignment)
+#
+# The SLO is defined at the REFERENCE SHAPE: Higgs-scale rows
+# (11M x 28), refresh_rounds=20 continuation rounds, 255-leaf trees, a
+# ~220-tree live forest, 4 warmed bucket shapes, 8 canary rows —
+# FRESHNESS_SLO_S = 60 s end to end.  The train leg is charged at the
+# same MEASURED TRAIN_ROWS_PER_S the checkpoint budgets use, so the two
+# models stay mutually consistent; warm is charged per compiled bucket
+# shape (the r12 deploy path compiles each padded batch bucket once).
+#
+# The guard-the-model entry turns the motivation into an invariant: a
+# COLD RETRAIN of the full forest at the same shape blows the SLO by
+# design (cmp="ge") — continuation is load-bearing, not an
+# optimization.  FRESHNESS_BUDGETS runs in the default lint pass
+# (analysis.cli, section "freshness") next to the serving/checkpoint
+# budgets.
+# ---------------------------------------------------------------------------
+
+WARM_COMPILE_S_PER_SHAPE = 0.4
+DAEMON_TICK_S = 1.0
+CANARY_ORACLE_S_PER_ROW_TREE = 1e-7
+FLIP_S = 1e-3
+FRESHNESS_SLO_S = 60.0
+
+
+def staleness_model(n_rows: int = 11_000_000, refresh_rounds: int = 20,
+                    num_leaves: int = 255, trees_total: int = 220,
+                    num_class: int = 1, warm_shapes: int = 4,
+                    canary_rows: int = 8,
+                    tick_s: float = DAEMON_TICK_S) -> Dict[str, float]:
+    """Closed-form staleness decomposition at one operating point.
+
+    ``trees_total`` is the forest size AFTER the refresh (continuation
+    replays + extends; a cold retrain instead sets
+    ``refresh_rounds = trees_total``).  Returns per-leg seconds plus
+    ``staleness_s`` and ``train_frac`` (train leg / total — the
+    quantity that says the pipeline is train-bound, with serving-side
+    legs amortized).
+    """
+    round_s = int(n_rows) / TRAIN_ROWS_PER_S
+    train_s = max(int(refresh_rounds), 0) * round_s
+    nodes = 2 * int(num_leaves) - 1
+    node_bytes = 7 * 4 + 1
+    artifact_bytes = (int(trees_total) * int(num_class) * nodes
+                      * node_bytes + 4096)
+    publish_s = artifact_bytes / HOST_WRITE_BYTES_PER_S \
+        + CKPT_FIXED_LATENCY_S
+    warm_s = int(warm_shapes) * WARM_COMPILE_S_PER_SHAPE
+    canary_s = (2 * SERVE_DISPATCH_FIXED_S
+                + int(canary_rows) * int(trees_total) * int(num_class)
+                * CANARY_ORACLE_S_PER_ROW_TREE)
+    staleness_s = (float(tick_s) + train_s + publish_s + warm_s
+                   + canary_s + FLIP_S)
+    return {
+        "wait_s": float(tick_s),
+        "train_s": train_s,
+        "publish_s": publish_s,
+        "warm_s": warm_s,
+        "canary_s": canary_s,
+        "flip_s": FLIP_S,
+        "artifact_mb": artifact_bytes / 1e6,
+        "staleness_s": staleness_s,
+        "train_frac": train_s / staleness_s,
+    }
+
+
+@dataclass(frozen=True)
+class FreshnessBudget:
+    """One staleness invariant at a reference operating point.
+
+    ``metric`` selects what ``staleness_model`` output is compared
+    ("staleness_s" for the SLO bars, "train_frac" for the
+    decomposition-shape bars).  ``cmp`` is "le" for the acceptance bars
+    and "ge" for budgeted-from-below guards (the operating point is
+    MEANT to breach — proving the model separates refresh from
+    retrain)."""
+
+    name: str
+    budget: float
+    cmp: str = "le"
+    metric: str = "staleness_s"
+    n_rows: int = 11_000_000
+    refresh_rounds: int = 20
+    num_leaves: int = 255
+    trees_total: int = 220
+    num_class: int = 1
+    warm_shapes: int = 4
+    canary_rows: int = 8
+    tick_s: float = DAEMON_TICK_S
+    note: str = ""
+
+    def check(self) -> Dict[str, object]:
+        t = staleness_model(
+            self.n_rows, self.refresh_rounds, self.num_leaves,
+            self.trees_total, self.num_class, self.warm_shapes,
+            self.canary_rows, self.tick_s)
+        measured = t[self.metric]
+        ok = (measured <= self.budget if self.cmp == "le"
+              else measured >= self.budget)
+        return {"name": self.name, "mode": "freshness",
+                "metric": self.metric, "measured": round(measured, 4),
+                "budget": self.budget, "cmp": self.cmp,
+                "train_s": round(t["train_s"], 3),
+                "warm_s": round(t["warm_s"], 3),
+                "canary_s": round(t["canary_s"], 5),
+                "staleness_s": round(t["staleness_s"], 3),
+                "ok": ok, "note": self.note}
+
+
+FRESHNESS_BUDGETS: Tuple[FreshnessBudget, ...] = (
+    FreshnessBudget("freshness_slo_ref", FRESHNESS_SLO_S,
+                    note="r15 acceptance: 20 continuation rounds at "
+                         "Higgs-scale rows land a fresh model inside "
+                         "the 60 s staleness SLO, warm+canary "
+                         "included"),
+    FreshnessBudget("freshness_train_warm_canary_ref", FRESHNESS_SLO_S,
+                    tick_s=0.0,
+                    note="the ISSUE bar verbatim: train + warm + "
+                         "canary (+publish/flip) <= SLO with the wait "
+                         "leg excluded — the pipeline's own work fits "
+                         "the budget even before tick tuning"),
+    FreshnessBudget("freshness_small_shard_fast", 5.0, n_rows=1_048_576,
+                    refresh_rounds=5, trees_total=120,
+                    note="a 1M-row shard refresh of 5 rounds serves "
+                         "fresh in under 5 s — the interactive "
+                         "operating point"),
+    FreshnessBudget("freshness_train_bound_ref", 0.5, cmp="ge",
+                    metric="train_frac",
+                    note="decomposition shape: the train leg dominates "
+                         "staleness at the reference shape — warm, "
+                         "canary, publish and flip stay amortized "
+                         "overheads, not the bottleneck"),
+    FreshnessBudget("freshness_cold_retrain_blows_slo", FRESHNESS_SLO_S,
+                    cmp="ge", refresh_rounds=220,
+                    note="guard-the-model: retraining the full "
+                         "220-tree forest from scratch at the same "
+                         "shape CANNOT meet the SLO — continuation is "
+                         "load-bearing, not an optimization"),
+)
+
+
+def freshness_budget_by_name(name: str) -> FreshnessBudget:
+    for b in FRESHNESS_BUDGETS:
+        if b.name == name:
+            return b
+    raise KeyError(name)
+
+
+def check_freshness_budgets(names: Optional[List[str]] = None
+                            ) -> List[Dict[str, object]]:
+    specs = (FRESHNESS_BUDGETS if names is None
+             else [freshness_budget_by_name(n) for n in names])
+    return [b.check() for b in specs]

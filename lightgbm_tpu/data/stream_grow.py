@@ -200,6 +200,21 @@ def _block_pred_fn():
 
 
 @functools.lru_cache(maxsize=None)
+def _replay_add_fn():
+    """Jitted ``pred + shrink * delta`` used when a loaded forest is
+    replayed onto a streamed Dataset (model-file continuation, r15).
+    Jitted for the same FMA-contraction reason as
+    :func:`_pred_update_fn` — the replayed predictions must be
+    bit-identical to the ones the uninterrupted run carried."""
+
+    @jax.jit
+    def fn(pred, shrink, delta):
+        return pred + shrink * delta
+
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
 def _pred_update_fn(is_rf: bool):
     """Jitted train-score update.  MUST be jitted, not eager: under jit
     XLA:CPU contracts ``pred + shrink * leaf`` into an FMA exactly like

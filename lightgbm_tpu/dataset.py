@@ -646,6 +646,7 @@ class Dataset:
     def from_blocks(cls, blocks, label=None, *, weight=None,
                     params: Optional[Dict[str, Any]] = None,
                     feature_name: Union[str, Sequence[str]] = "auto",
+                    reference: Optional["Dataset"] = None,
                     ) -> "Dataset":
         """Build a STREAMED dataset from row blocks without materializing
         the raw matrix (ISSUE 7 tentpole: the HBM ceiling becomes the
@@ -667,6 +668,14 @@ class Dataset:
         splits, no EFB — bundling needs global co-occurrence stats), and
         labels/weights/masks stay device-resident (O(n) vectors; the
         [n, F] code matrix is what streaming evicts from HBM).
+
+        ``reference`` (r15) pins the binning schema: the new Dataset
+        reuses ``reference``'s already-fit BinMapper verbatim (the
+        sketch-fit pass is skipped) so growing data keeps an IDENTICAL
+        schema digest across generations — the contract model-file /
+        checkpoint continuation enforces.  ``reference`` may be an
+        earlier streamed or in-memory Dataset (must be constructed, no
+        EFB bundling).
         """
         import jax.numpy as jnp
         from .data import BlockStore, StreamingBinMapperBuilder
@@ -711,21 +720,39 @@ class Dataset:
                 f"multiple of {ROW_PAD_MULTIPLE} (bit-identity with the "
                 "in-memory row_chunk path needs lane-aligned blocks)")
 
-        # pass 1: streaming quantile sketch -> BinMapper
+        ref_mapper = None
+        if reference is not None:
+            ref_mapper = getattr(reference, "bin_mapper", reference)
+            if ref_mapper is None:
+                raise ValueError(
+                    "reference= Dataset has no fitted BinMapper — call "
+                    "construct() on it (or train with it) first")
+            if getattr(ref_mapper, "bundler", None) is not None:
+                raise ValueError(
+                    "reference= Dataset was built with EFB bundling, "
+                    "which streamed datasets do not support — rebuild "
+                    "the reference with enable_bundle=false")
+
+        # pass 1: streaming quantile sketch -> BinMapper (skipped when a
+        # reference pins the schema; the loop still validates blocks and
+        # collects labels/weights)
         builder = None
         first_dtype = None
         y_parts: List[np.ndarray] = []
         w_parts: List[np.ndarray] = []
         blocks_have_y = blocks_have_w = False
+        saw_block = False
         for idx, b in enumerate(make_iter()):
             x, ys, ws = split_block(b, idx)
-            if builder is None:
+            if not saw_block:
+                saw_block = True
                 first_dtype = x.dtype
-                builder = StreamingBinMapperBuilder(
-                    x.shape[1],
-                    capacity=int(p.extra.get("stream_sketch_capacity",
-                                             200_000)),
-                    eps=float(p.extra.get("stream_sketch_eps", 1e-3)))
+                if ref_mapper is None:
+                    builder = StreamingBinMapperBuilder(
+                        x.shape[1],
+                        capacity=int(p.extra.get("stream_sketch_capacity",
+                                                 200_000)),
+                        eps=float(p.extra.get("stream_sketch_eps", 1e-3)))
                 blocks_have_y = ys is not None
                 blocks_have_w = ws is not None
             if x.dtype != first_dtype:
@@ -737,18 +764,24 @@ class Dataset:
                 raise ValueError(
                     f"block {idx}: inconsistent (X, y[, w]) tuple shape "
                     "across blocks")
-            builder.update(x)   # raises on ragged feature counts
+            if builder is not None:
+                builder.update(x)   # raises on ragged feature counts
+            elif x.shape[1] != ref_mapper.num_features:
+                raise ValueError(
+                    f"block {idx}: {x.shape[1]} features != reference "
+                    f"Dataset's {ref_mapper.num_features}")
             if ys is not None:
                 y_parts.append(np.asarray(ys, np.float64).reshape(-1))
             if ws is not None:
                 w_parts.append(np.asarray(ws, np.float64).reshape(-1))
-        if builder is None:
+        if not saw_block:
             raise ValueError("from_blocks: empty block iterator")
         if blocks_have_y and label is not None:
             raise ValueError(
                 "labels supplied both per-block and via label= — pick one")
-        mapper = builder.finalize(max_bin=p.max_bin,
-                                  min_data_in_bin=p.min_data_in_bin)
+        mapper = (ref_mapper if ref_mapper is not None
+                  else builder.finalize(max_bin=p.max_bin,
+                                        min_data_in_bin=p.min_data_in_bin))
 
         # pass 2: bin each block and pack the codes host-side
         writer = BlockStore.writer(block_rows)

@@ -38,6 +38,23 @@ Training (consulted by ``data/block_store.py`` and ``training/``):
   gradient/hessian finiteness screen (:class:`NonFiniteGradientError`)
   is exercised end to end.
 
+Pipeline (consulted by ``pipeline/daemon.py`` — the r15 refresh loop):
+
+* ``data_arrival`` — raises while the daemon polls its block feed
+  (a watch/listing outage); the poll is retried next tick, arrivals
+  are never lost.
+* ``continue_train`` — raises at a round boundary of the continuation
+  training run, modeling a mid-refresh preemption; the daemon resumes
+  the SAME generation from its last checkpoint and still converges to
+  the same flip.
+* ``artifact_push`` — fires during the versioned-artifact publish,
+  modeling a torn/corrupted push; the written artifact is corrupted so
+  ModelBank ingest/canary rejects it and the prior version keeps
+  serving, with a clean re-push next tick.
+* ``flip`` — raises immediately after a successful atomic flip,
+  modeling a post-flip health alarm; the daemon rolls the bank back to
+  the prior version and re-anchors continuation on it.
+
 A ``FaultInjector`` with no armed specs is a cheap no-op, so the hooks
 stay wired in production configurations.
 """
@@ -49,7 +66,8 @@ from typing import Dict, List, Optional
 
 SERVING_SITES = ("device_predict", "artifact_load", "compile", "clock")
 TRAINING_SITES = ("block_read", "device_put", "checkpoint_write", "gradient")
-SITES = SERVING_SITES + TRAINING_SITES
+PIPELINE_SITES = ("data_arrival", "continue_train", "artifact_push", "flip")
+SITES = SERVING_SITES + TRAINING_SITES + PIPELINE_SITES
 
 
 class FaultError(RuntimeError):

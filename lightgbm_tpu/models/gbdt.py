@@ -1490,6 +1490,24 @@ class Booster:
                              np.float32)])
                 self._pred_train = self._pred_train + jnp.asarray(base)
         shrink = jnp.float32(self._base_lr)
+        if getattr(self, "_streamed", False):
+            # no resident X_binned on a streamed Dataset: replay each
+            # tree with one traversal pass over the block store, then
+            # apply the SAME jitted update shape the live streamed
+            # rounds use — under jit XLA:CPU contracts the mul+add into
+            # an FMA; an eager update would round differently and every
+            # continued round would see 1-ulp-different gradients
+            from ..data.stream_grow import _block_pred_fn, _replay_add_fn
+            pred_fn = _block_pred_fn()
+            store = ds.block_store
+            for tree in self.trees:
+                deltas = [pred_fn(tree, bins_b)
+                          for _, bins_b in store.device_blocks()]
+                delta = (deltas[0] if len(deltas) == 1
+                         else jnp.concatenate(deltas))
+                self._pred_train = _replay_add_fn()(
+                    self._pred_train, shrink, delta)
+            return
         if p.linear_tree:
             add_lin = _linear_tree_pred_fn(self._depth_cap)
             for tree in self.trees:
@@ -1527,12 +1545,23 @@ class Booster:
         loaded_iter = self._iter
         self.train_set = ds
         self._setup_training()
-        if getattr(self, "_streamed", False):
-            raise NotImplementedError(
-                "continued training from a saved model file is not "
-                "supported on a streamed (from_blocks) Dataset — resume "
-                "from a training checkpoint (lightgbm_tpu.training) "
-                "instead, which carries the streamed prediction state")
+        if getattr(self, "_streamed", False) and prev_m is not None:
+            # streamed continuation (r15): the split_bin codes in the
+            # loaded forest only mean something under the binning they
+            # were trained with — enforce via the checkpoint-grade
+            # schema digest (covers bounds, nan bin, bin counts,
+            # categorical flags, EFB bundling), same contract as
+            # training.checkpoint.resume_booster
+            from ..data.sketch import schema_digest
+            got = schema_digest(ds.bin_mapper)
+            want = schema_digest(prev_m)
+            if got != want:
+                raise ValueError(
+                    "this Booster was saved under a different binning "
+                    f"schema (digest {want[:12]}… vs the streamed "
+                    f"Dataset's {got[:12]}…); rebuild the blocks with "
+                    "Dataset.from_blocks(..., reference=<original "
+                    "training Dataset>) before continuing training")
         self._iter = loaded_iter
         self._forest_cache = None
         self._rebase_and_replay(loaded_init)

@@ -86,6 +86,7 @@ def train_resumable(
     injector=None,
     round_callbacks: Optional[List[Callable]] = None,
     finite_screen: bool = True,
+    init_model: Optional[str] = None,
 ) -> TrainResult:
     """Train with checkpoint/resume + preemption drain; see module doc.
 
@@ -93,6 +94,14 @@ def train_resumable(
     ``cb(booster, round_index)`` — the chaos tests use one to deliver a
     real SIGTERM at an exact round.  ``resume`` may also be a checkpoint
     path to pin the exact artifact to resume from.
+
+    ``init_model`` (r15) seeds the run by CONTINUING a saved model file
+    (``.txt``/``.json``/packed ``.npz``) when no checkpoint exists yet —
+    the refresh-daemon path: generation N trains from the live model of
+    generation N-1, while a mid-generation preemption still resumes from
+    this generation's own checkpoints (which take precedence, carrying
+    the exact round state).  Params come from the model file; the
+    offered Dataset must carry the same binning schema.
     """
     from ..config import parse_params
     from ..models.gbdt import Booster
@@ -117,6 +126,10 @@ def train_resumable(
                 booster = resume_booster(
                     (found["arrays"], found["meta"]), train_set)
                 resumed_from = last_checkpoint = path
+    if booster is None and init_model is not None:
+        booster = Booster(model_file=init_model)
+        booster._attach_continuation(train_set)
+        resumed_from = init_model
     if booster is None:
         p = params if not isinstance(params, dict) else parse_params(params)
         booster = Booster(p, train_set)

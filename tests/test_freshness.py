@@ -1,0 +1,502 @@
+"""Freshness pipeline tests (ISSUE r15 tentpole + satellites).
+
+The continuous refresh loop: streamed model-file continuation (the
+lifted fence) with schema-digest enforcement, ``Dataset.from_blocks``
+schema pinning via ``reference=``, the RefreshDaemon's
+data-arrival -> continue-train -> publish -> canary -> flip loop on a
+deterministic sim clock with chaos at every new fault site, the
+staleness tracker/SLO arithmetic, the ``task=refresh`` CLI contract,
+and the analytic FRESHNESS_BUDGETS.
+"""
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.__main__ import _refresh, main as cli_main
+from lightgbm_tpu.analysis.budgets import (FRESHNESS_BUDGETS,
+                                           check_freshness_budgets,
+                                           freshness_budget_by_name,
+                                           staleness_model)
+from lightgbm_tpu.data.sketch import schema_digest
+from lightgbm_tpu.dataset import Dataset
+from lightgbm_tpu.faults import (PIPELINE_SITES, SERVING_SITES, SITES,
+                                 TRAINING_SITES, FaultInjector, FaultSpec)
+from lightgbm_tpu.models.gbdt import Booster
+from lightgbm_tpu.pipeline import (ArrivalFeed, DirectoryFeed, RefreshDaemon,
+                                   RefreshRecord, SimClock, StalenessTracker,
+                                   latest_artifact)
+from lightgbm_tpu.serving.packed import PackedForest, pack_booster
+from lightgbm_tpu.training import latest_checkpoint, train_resumable
+
+PARAMS = dict(objective="binary", num_leaves=7, learning_rate=0.2,
+              max_bin=31, min_data_in_leaf=5, verbose=-1, seed=7,
+              stream_block_rows=256)
+# dyadic stage costs -> exact float sums -> exact staleness assertions
+COSTS = dict(dataset_build=0.5, train_round=0.25, publish=0.25,
+             deploy=1.0, flip=0.5)
+
+
+def _problem(n=512, f=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0, 1, (n, f)).astype(np.float32)
+    w = rng.normal(0, 1, f)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ w)))).astype(np.float32)
+    return X, y
+
+
+def _blocks(X, y, rows=256):
+    return [(X[lo:lo + rows], y[lo:lo + rows])
+            for lo in range(0, len(X), rows)]
+
+
+def _trees_equal(a, b):
+    ta = a.trees if hasattr(a, "trees") else a
+    tb = b.trees if hasattr(b, "trees") else b
+    if len(ta) != len(tb):
+        return False
+    return all(np.array_equal(np.asarray(getattr(x, f)),
+                              np.asarray(getattr(y, f)))
+               for x, y in zip(ta, tb)
+               for f in ("split_feature", "split_bin", "left", "right",
+                         "leaf_value", "is_leaf"))
+
+
+def _daemon(state_dir, clock, *, injector=None, stage_costs=None,
+            refresh_rounds=3, initial_rounds=4, slo_ms=None):
+    feed = ArrivalFeed(clock)
+    d = RefreshDaemon(PARAMS, str(state_dir), feed=feed,
+                      refresh_rounds=refresh_rounds,
+                      initial_rounds=initial_rounds,
+                      checkpoint_rounds=2, staleness_slo_ms=slo_ms,
+                      canary_rows=4, clock=clock, injector=injector,
+                      stage_costs=stage_costs)
+    return d, feed
+
+
+# -- satellite 1: streamed model-file continuation (the lifted fence) ----
+
+
+def test_streamed_continuation_bit_identical_both_codecs(tmp_path):
+    X, y = _problem()
+    blocks = _blocks(X, y)
+
+    def ds():
+        return Dataset.from_blocks(blocks, params=dict(PARAMS))
+
+    ref = lgb.train(dict(PARAMS), ds(), num_boost_round=5)
+    base = lgb.train(dict(PARAMS), ds(), num_boost_round=3)
+    for codec, name in (("txt", "m.txt"), ("npz", "m.npz")):
+        path = str(tmp_path / name)
+        if codec == "npz":
+            pack_booster(base).save(path)
+        else:
+            base.save_model(path)
+        cont = Booster(model_file=path)
+        dsc = ds()
+        cont.update(train_set=dsc)
+        cont.update()
+        assert cont.num_trees() == 5, codec
+        assert _trees_equal(ref, cont), codec
+
+
+def test_streamed_continuation_refuses_rebinned_blocks(tmp_path):
+    X, y = _problem()
+    base = lgb.train(dict(PARAMS),
+                     Dataset.from_blocks(_blocks(X, y),
+                                         params=dict(PARAMS)),
+                     num_boost_round=2)
+    path = str(tmp_path / "m.txt")
+    base.save_model(path)
+    cont = Booster(model_file=path)
+    X2, y2 = _problem(seed=99)
+    rebinned = Dataset.from_blocks(_blocks(X2 * 3.0 + 1.0, y2),
+                                   params=dict(PARAMS))
+    with pytest.raises(ValueError, match="binning|schema"):
+        cont.update(train_set=rebinned)
+
+
+def test_from_blocks_reference_pins_schema_digest():
+    X, y = _problem()
+    ds1 = Dataset.from_blocks(_blocks(X, y), params=dict(PARAMS))
+    ds1.construct()
+    X2, y2 = _problem(seed=3)
+    grown = _blocks(X, y) + _blocks(X2 * 5.0 - 2.0, y2)
+    ds2 = Dataset.from_blocks(grown, params=dict(PARAMS), reference=ds1)
+    ds2.construct()
+    assert schema_digest(ds2.bin_mapper) == schema_digest(ds1.bin_mapper)
+    # without the reference the grown rows shift the quantile sketch
+    ds3 = Dataset.from_blocks(grown, params=dict(PARAMS))
+    ds3.construct()
+    assert schema_digest(ds3.bin_mapper) != schema_digest(ds1.bin_mapper)
+
+
+def test_from_blocks_reference_rejections():
+    X, y = _problem()
+    with pytest.raises(ValueError, match="BinMapper"):
+        Dataset.from_blocks(_blocks(X, y), params=dict(PARAMS),
+                            reference=Dataset(X, label=y))  # unconstructed
+    ds1 = Dataset.from_blocks(_blocks(X, y), params=dict(PARAMS))
+    ds1.construct()
+    with pytest.raises(ValueError, match="reference"):
+        Dataset.from_blocks(_blocks(X[:, :3], y), params=dict(PARAMS),
+                            reference=ds1).construct()
+    # EFB-bundled references can't pin a streamed schema
+    rng = np.random.default_rng(5)
+    cat = rng.integers(0, 8, 600)
+    onehot = np.zeros((600, 8), np.float32)
+    onehot[np.arange(600), cat] = 1.0
+    Xb = np.concatenate([rng.normal(size=(600, 2)).astype(np.float32),
+                         onehot], axis=1)
+    yb = (cat % 2).astype(np.float32)
+    dsb = lgb.Dataset(Xb, label=yb)
+    dsb.construct()
+    assert dsb.bin_mapper.bundler is not None
+    with pytest.raises(ValueError, match="EFB"):
+        Dataset.from_blocks(_blocks(Xb, y[:600]), params=dict(PARAMS),
+                            reference=dsb)
+
+
+# -- satellite 2: shared fault registry grows pipeline sites -------------
+
+
+def test_pipeline_sites_and_shim_surface():
+    assert PIPELINE_SITES == ("data_arrival", "continue_train",
+                              "artifact_push", "flip")
+    assert SITES == SERVING_SITES + TRAINING_SITES + PIPELINE_SITES
+    inj = FaultInjector()
+    assert set(PIPELINE_SITES) <= set(inj.hits)
+    # the serving shim keeps its pre-move surface, same objects
+    from lightgbm_tpu.serving import faults as shim
+    import lightgbm_tpu.faults as canonical
+    for name in shim.__all__:
+        assert getattr(shim, name) is getattr(canonical, name)
+
+
+# -- train_resumable init_model (the daemon's continuation seed) ---------
+
+
+def test_train_resumable_init_model_seeds_continuation(tmp_path):
+    X, y = _problem()
+    blocks = _blocks(X, y)
+
+    def ds():
+        return Dataset.from_blocks(blocks, params=dict(PARAMS))
+
+    ref = lgb.train(dict(PARAMS), ds(), num_boost_round=5)
+    base = lgb.train(dict(PARAMS), ds(), num_boost_round=3)
+    path = str(tmp_path / "m.txt")
+    base.save_model(path)
+    res = train_resumable(dict(PARAMS), ds(), 5,
+                          checkpoint_dir=str(tmp_path / "ckpt"),
+                          checkpoint_rounds=2, resume=True,
+                          init_model=path)
+    assert res.completed and res.rounds_done == 5
+    assert res.resumed_from == path
+    assert _trees_equal(ref, res.booster)
+
+
+# -- daemon: deterministic staleness on the sim clock --------------------
+
+
+def test_daemon_single_refresh_exact_staleness(tmp_path):
+    clock = SimClock()
+    d, feed = _daemon(tmp_path, clock, stage_costs=COSTS, slo_ms=10_000.0)
+    X, y = _problem()
+    feed.push(X, y)                       # arrives at t=0
+    clock.advance(0.25)                   # daemon tick latency
+    ev = d.tick()
+    assert ev["event"] == "flipped" and ev["version"] == "g0001"
+    rec = d.tracker.record(1)
+    # 4 initial rounds: train leg = dataset_build + 4*train_round = 1.5
+    dec = rec.decomposition()
+    assert dec["wait"] == 0.25
+    assert dec["train"] == COSTS["dataset_build"] + 4 * COSTS["train_round"]
+    assert dec["publish"] == COSTS["publish"]
+    assert dec["deploy"] == COSTS["deploy"]
+    assert dec["flip"] == COSTS["flip"]
+    assert ev["staleness_ms"] == 3500.0
+    assert d.tracker.worst_staleness_ms() == 3500.0
+    assert d.tracker.breaches() == []
+    assert d.bank.version("model") == "g0001"
+    assert d.tick() is None               # idle once drained
+    # a second generation continues the live model, 3 more rounds
+    feed.push(*_problem(seed=1))
+    ev2 = d.tick()
+    assert ev2["event"] == "flipped" and ev2["rounds"] == 7
+    assert d.tracker.record(2).decomposition()["train"] == \
+        COSTS["dataset_build"] + 3 * COSTS["train_round"]
+
+
+def test_daemon_slo_breach_is_reported_not_enforced(tmp_path):
+    clock = SimClock()
+    d, feed = _daemon(tmp_path, clock, stage_costs=COSTS, slo_ms=1_000.0)
+    feed.push(*_problem())
+    ev = d.tick()
+    assert ev["event"] == "flipped"       # the flip still lands
+    assert d.tracker.breaches() == [1]
+    assert d.snapshot()["staleness"]["breaches"] == [1]
+
+
+# -- daemon chaos: preemption / corrupt artifact / rollback --------------
+
+
+def test_daemon_preemption_resumes_from_checkpoint(tmp_path):
+    inj = FaultInjector()
+    clock = SimClock()
+    d, feed = _daemon(tmp_path, clock, injector=inj)
+    ctrl, cfeed = _daemon(tmp_path / "ctrl", SimClock())
+    for f_, blk in ((feed, 0), (cfeed, 0)):
+        f_.push(*_problem(seed=blk))
+    assert d.tick()["event"] == "flipped"
+    assert ctrl.tick()["event"] == "flipped"
+    # gen 2 trains rounds 5..7 (checkpoint cadence 2 -> checkpoint at
+    # round 6); hits are global per site, so arm RELATIVE: +2 fires at
+    # round 7, after the round-6 checkpoint landed
+    inj.arm(FaultSpec(site="continue_train",
+                      after=inj.hits["continue_train"] + 2, times=1))
+    feed.push(*_problem(seed=1))
+    cfeed.push(*_problem(seed=1))
+    ev = d.tick()
+    assert ev["event"] == "preempted"
+    assert d.tracker.record(2).status == "preempted"
+    # version N-1 keeps serving from the same state dir while gen N's
+    # checkpoint sits on disk (satellite 3)
+    assert d.bank.version("model") == "g0001"
+    ck = latest_checkpoint(str(tmp_path / "ckpt" / "gen_0002"))
+    assert ck is not None and ck.endswith(".lgckpt")
+    retry = d.tick()
+    assert retry["event"] == "flipped"
+    assert str(retry["resumed_from"]).endswith(".lgckpt")
+    assert d.tracker.record(2).attempts == 2
+    assert ctrl.tick()["event"] == "flipped"
+    # preempted-and-resumed converges to the unpreempted flip
+    pa = PackedForest.load(d._live_path)
+    pb = PackedForest.load(ctrl._live_path)
+    for f in ("split_feature", "split_bin", "left", "right",
+              "leaf_value", "is_leaf"):
+        assert np.array_equal(getattr(pa, f), getattr(pb, f)), f
+
+
+def test_daemon_corrupt_artifact_rejected_prior_serves(tmp_path):
+    inj = FaultInjector()
+    d, feed = _daemon(tmp_path, SimClock(), injector=inj)
+    feed.push(*_problem())
+    assert d.tick()["event"] == "flipped"
+    probe = np.random.default_rng(9).normal(size=(16, 5))
+    before = d.bank.predict("model", probe)
+    inj.arm(FaultSpec(site="artifact_push", after=0, times=1))
+    feed.push(*_problem(seed=1))
+    ev = d.tick()
+    assert ev["event"] == "rejected" and ev["poisoned"]
+    assert ev["stage"] == "ingest"        # NaN leaves die at validation
+    assert d.bank.version("model") == "g0001"
+    assert np.array_equal(before, d.bank.predict("model", probe))
+    retry = d.tick()
+    assert retry["event"] == "flipped"
+    assert d.bank.version("model") == "g0002"
+
+
+def test_daemon_flip_fault_rolls_back_and_reanchors(tmp_path):
+    inj = FaultInjector()
+    d, feed = _daemon(tmp_path, SimClock(), injector=inj)
+    feed.push(*_problem())
+    assert d.tick()["event"] == "flipped"
+    probe = np.random.default_rng(9).normal(size=(16, 5))
+    before = d.bank.predict("model", probe)
+    inj.arm(FaultSpec(site="flip", after=0, times=1))
+    feed.push(*_problem(seed=1))
+    ev = d.tick()
+    assert ev["event"] == "rolled_back"
+    assert d.bank.version("model") == "g0001"
+    assert np.array_equal(before, d.bank.predict("model", probe))
+    assert d.tracker.record(2).status == "rolled_back"
+    # next generation re-anchors continuation on the reverted model
+    feed.push(*_problem(seed=2))
+    nxt = d.tick()
+    assert nxt["event"] == "flipped" and nxt["generation"] == 3
+    assert nxt["rounds"] == 4 + 3         # initial + one refresh
+
+
+def test_daemon_poll_fault_never_loses_arrivals(tmp_path):
+    inj = FaultInjector()
+    d, feed = _daemon(tmp_path, SimClock(), injector=inj)
+    feed.push(*_problem())
+    inj.arm(FaultSpec(site="data_arrival", after=0, times=1))
+    ev = d.tick()
+    assert ev["event"] == "poll_fault" and d.poll_faults == 1
+    ev = d.tick()                         # retried tick picks them up
+    assert ev["event"] == "flipped"
+
+
+# -- satellite 3: restart re-anchoring + in-progress artifact skip -------
+
+
+def test_latest_artifact_skips_tmp_and_daemon_reanchors(tmp_path):
+    d, feed = _daemon(tmp_path, SimClock())
+    feed.push(*_problem())
+    assert d.tick()["event"] == "flipped"
+    models = d.models_dir
+    # a torn publish leaves a .tmp- sibling; it must never be picked up
+    open(os.path.join(models, ".tmp-model_g0002.npz"), "wb").close()
+    path, gen = latest_artifact(models)
+    assert gen == 1 and path.endswith("model_g0001.npz")
+    # a fresh daemon over the same state dir re-anchors on g0001
+    d2 = RefreshDaemon(PARAMS, str(tmp_path), feed=ArrivalFeed(SimClock()),
+                       refresh_rounds=3, initial_rounds=4,
+                       clock=SimClock())
+    assert d2._gen == 1 and d2._live_rounds == 4
+    assert d2.bank.version("model") == "g0001"
+    feed2 = d2.feed
+    feed2.push(*_problem(seed=1))
+    ev = d2.tick()
+    assert ev["event"] == "flipped" and ev["version"] == "g0002"
+    assert ev["rounds"] == 7
+    assert str(ev["resumed_from"]).endswith("model_g0001.npz")
+
+
+def test_checkpoint_load_latest_skips_tmp(tmp_path):
+    X, y = _problem()
+    res = train_resumable(dict(PARAMS),
+                          Dataset.from_blocks(_blocks(X, y),
+                                              params=dict(PARAMS)),
+                          4, checkpoint_dir=str(tmp_path),
+                          checkpoint_rounds=2)
+    real = latest_checkpoint(str(tmp_path))
+    assert real is not None
+    open(os.path.join(str(tmp_path), ".tmp-ckpt_00000099.lgckpt"),
+         "wb").close()
+    assert latest_checkpoint(str(tmp_path)) == real
+
+
+def test_directory_feed_skips_tmp_and_requires_xy(tmp_path):
+    X, y = _problem(n=256)
+    feed = DirectoryFeed(str(tmp_path), SimClock())
+    np.savez(str(tmp_path / "b0.npz"), X=X, y=y)
+    open(str(tmp_path / "b1.npz.tmp"), "wb").close()
+    got = feed.poll()
+    assert len(got) == 1 and got[0].X.shape == (256, 5)
+    assert feed.poll() == []              # absorbed once
+    np.savez(str(tmp_path / "bad.npz"), Z=X)
+    with pytest.raises(ValueError, match="'X' and 'y'"):
+        feed.poll()
+
+
+# -- staleness arithmetic ------------------------------------------------
+
+
+def test_refresh_record_and_tracker_arithmetic():
+    rec = RefreshRecord(generation=1)
+    with pytest.raises(ValueError, match="unknown stage"):
+        rec.stamp("nope", 0.0)
+    for stage, t in zip(("data_arrival", "train_start", "trained",
+                         "artifact_saved", "canaried", "serving"),
+                        (1.0, 1.5, 3.0, 3.25, 4.25, 4.5)):
+        rec.stamp(stage, t)
+    assert rec.staleness_s() == 3.5
+    dec = rec.decomposition()
+    assert dec == {"wait": 0.5, "train": 1.5, "publish": 0.25,
+                   "deploy": 1.0, "flip": 0.25, "staleness": 3.5}
+    assert rec.as_dict()["staleness_ms"] == 3500.0
+
+    tr = StalenessTracker(slo_ms=2_000.0)
+    r1 = tr.begin(1)
+    assert tr.begin(1) is r1 and r1.attempts == 2
+    r1.stamps.update(rec.stamps)
+    r1.status = "serving"
+    assert tr.worst_staleness_ms() == 3500.0
+    assert tr.breaches() == [1]
+    snap = tr.snapshot()
+    assert snap["served"] == 1 and snap["slo_ms"] == 2000.0
+
+    clock = SimClock(10.0)
+    assert clock() == 10.0 and clock.advance(0.5) == 10.5
+    with pytest.raises(ValueError, match="backwards"):
+        clock.advance(-1.0)
+
+
+# -- freshness budgets (wired into default lint) -------------------------
+
+
+def test_staleness_model_and_budgets_green():
+    m = staleness_model()
+    for key in ("wait_s", "train_s", "publish_s", "warm_s", "canary_s",
+                "flip_s", "staleness_s", "train_frac"):
+        assert key in m
+    assert m["staleness_s"] > m["train_s"] > 0
+    res = check_freshness_budgets()
+    assert len(res) == len(FRESHNESS_BUDGETS) == 5
+    assert all(r["ok"] for r in res)
+    names = {r["name"] for r in res}
+    assert {"freshness_slo_ref", "freshness_train_warm_canary_ref",
+            "freshness_cold_retrain_blows_slo"} <= names
+    # the guard-the-model bar: a cold retrain MUST blow the SLO
+    cold = freshness_budget_by_name("freshness_cold_retrain_blows_slo")
+    assert cold.cmp == "ge" and cold.check()["ok"]
+    with pytest.raises(KeyError):
+        freshness_budget_by_name("nope")
+    sub = check_freshness_budgets(names=["freshness_slo_ref"])
+    assert len(sub) == 1 and sub[0]["name"] == "freshness_slo_ref"
+
+
+# -- satellite 6: task=refresh CLI contract ------------------------------
+
+
+def _cli_cfg(tmp_path, **over):
+    cfg = {"watch_dir": str(tmp_path / "watch"),
+           "state_dir": str(tmp_path / "state"),
+           "objective": "binary", "num_leaves": "7",
+           "learning_rate": "0.2", "max_bin": "31",
+           "min_data_in_leaf": "5", "verbose": "-1", "seed": "7",
+           "stream_block_rows": "256", "refresh_rounds": "2"}
+    cfg.update(over)
+    return cfg
+
+
+def test_refresh_cli_key_validation(tmp_path):
+    with pytest.raises(SystemExit, match="watch_dir"):
+        _refresh({})
+    with pytest.raises(SystemExit, match="state_dir"):
+        _refresh({"watch_dir": str(tmp_path)})
+    with pytest.raises(SystemExit, match="unknown key"):
+        _refresh(_cli_cfg(tmp_path, bogus_knob="1"))
+    with pytest.raises(SystemExit, match="integer"):
+        _refresh(_cli_cfg(tmp_path, refresh_rounds="five"))
+    with pytest.raises(SystemExit, match=">= 1"):
+        _refresh(_cli_cfg(tmp_path, max_ticks="0"))
+    with pytest.raises(SystemExit, match="staleness_slo_ms"):
+        _refresh(_cli_cfg(tmp_path, staleness_slo_ms="-3"))
+
+
+def test_refresh_cli_misuse_is_typed_not_traceback():
+    # flag-style misuse dies with usage, not a KeyError traceback
+    with pytest.raises(SystemExit, match="usage"):
+        cli_main(["task=refresh", "--help"])
+    with pytest.raises(SystemExit, match="refresh"):
+        cli_main(["task=refres"])
+
+
+def test_refresh_cli_end_to_end(tmp_path):
+    watch = tmp_path / "watch"
+    watch.mkdir()
+    X, y = _problem()
+    np.savez(str(watch / "block0.npz"), X=X[:256], y=y[:256])
+    np.savez(str(watch / "block1.npz"), X=X[256:], y=y[256:])
+    out, err = io.StringIO(), io.StringIO()
+    assert _refresh(_cli_cfg(tmp_path), stdout=out, stderr=err) == 0
+    events = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    assert [e["event"] for e in events] == ["flipped"]
+    assert events[0]["version"] == "g0001"
+    summary = json.loads(err.getvalue())
+    assert summary["generation"] == 1 and summary["served"] == 1
+    # rerunning the same command line re-anchors and continues
+    np.savez(str(watch / "block2.npz"), X=X[:256], y=1.0 - y[:256])
+    out2 = io.StringIO()
+    assert _refresh(_cli_cfg(tmp_path), stdout=out2,
+                    stderr=io.StringIO()) == 0
+    ev2 = [json.loads(ln) for ln in out2.getvalue().splitlines()]
+    assert ev2[-1]["version"] == "g0002" and ev2[-1]["rounds"] == 4

@@ -78,7 +78,8 @@ def _streamed(block_rows=256, seed=0, **extra):
 def test_shared_registry_and_serving_backward_compat():
     assert set(TRAINING_SITES) == {"block_read", "device_put",
                                    "checkpoint_write", "gradient"}
-    assert SITES == SERVING_SITES + TRAINING_SITES
+    from lightgbm_tpu.faults import PIPELINE_SITES
+    assert SITES == SERVING_SITES + TRAINING_SITES + PIPELINE_SITES
     # the serving shim must re-export the SAME objects, training sites
     # included, so existing serving chaos code keeps working unchanged
     from lightgbm_tpu.serving import faults as sfaults
@@ -317,20 +318,30 @@ def test_model_file_continuation_rejects_different_binning(tmp_path):
                                     params=dict(p)))
 
 
-def test_model_file_continuation_streamed_needs_checkpoint(tmp_path):
+def test_model_file_continuation_streamed_bit_identical(tmp_path):
+    """r15: the streamed-continuation fence is lifted — continuing a
+    saved model on a ``from_blocks`` Dataset replays the loaded forest
+    through the block loop and matches the uninterrupted run exactly."""
     X, y = _problem()
-    p = _cont_params()
-    b1 = lgb.Booster(dict(p), Dataset(X, label=y, params=dict(p)))
-    b1.update()
-    path = str(tmp_path / "model.json")
-    b1.save_model(path)
-
-    ps = dict(p, stream_block_rows=256)
+    ps = dict(_cont_params(), stream_block_rows=256)
     blocks = [(X[lo:lo + 256], y[lo:lo + 256])
               for lo in range(0, len(X), 256)]
+
+    def ds():
+        return Dataset.from_blocks(blocks, params=dict(ps))
+
+    ref = lgb.train(dict(ps), ds(), num_boost_round=5)
+    base = lgb.train(dict(ps), ds(), num_boost_round=3)
+    path = str(tmp_path / "model.json")
+    base.save_model(path)
+
     b2 = lgb.Booster(model_file=path)
-    with pytest.raises(NotImplementedError, match="checkpoint"):
-        b2.update(train_set=Dataset.from_blocks(blocks, params=dict(ps)))
+    ds2 = ds()
+    for _ in range(2):
+        b2.update(train_set=ds2)
+        ds2 = None
+    assert b2.num_trees() == 5
+    assert _trees_equal(ref, b2)
 
 
 # -- checkpoint-overhead budget (satellite 5) ----------------------------

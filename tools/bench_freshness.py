@@ -1,0 +1,414 @@
+"""Freshness-pipeline bench: measured model staleness + chaos matrix.
+
+Drives the r15 refresh loop (lightgbm_tpu.pipeline) end to end and
+records into ``BENCH_FRESHNESS_r15.json``:
+
+* **measured model staleness** — a multi-generation refresh loop on the
+  SIM CLOCK: data arrival -> continuation training -> versioned
+  PackedForest publish -> ModelBank ingest/warm/canary -> atomic flip,
+  with per-stage costs CALIBRATED from one real wall-clock refresh on
+  this host (the same calibrated-sim-clock provenance as
+  tools/bench_loadgen.py), so the staleness decomposition per
+  generation is honest AND bit-reproducible;
+* **zero dropped in-flight requests** — live traffic runs through the
+  ModelBank micro-batcher across every flip; requests submitted before
+  a flip resolve after it, none fail, none are dropped;
+* **the chaos matrix** — every refresh-stage fault site armed
+  deterministically: preemption mid-refresh (``continue_train``;
+  resumes from the generation's own checkpoint and converges to a
+  BIT-IDENTICAL flip vs the unpreempted control), corrupt artifact push
+  (``artifact_push`` poisons the published bytes; the bank rejects,
+  prior version keeps serving bit-identically, the retry re-publishes
+  clean), a canary-stage device fault (``device_predict`` during the
+  canary batch -> rejected at "canary"), and a post-flip rollback
+  (``flip`` -> instant revert, prior predictions bit-identical, the
+  next generation re-anchors on the reverted model);
+* **streamed continuation parity** — the lifted r15 fence:
+  ``Booster(model_file=...)`` + ``update()`` on a streamed Dataset is
+  np.array_equal to the uninterrupted streamed run, via BOTH the text
+  and the packed ``.npz`` codec;
+* **FRESHNESS_BUDGETS** — the analytic staleness model bars that also
+  run in the default lint pass.
+
+``acceptance_r15`` rolls all of it up; exit is nonzero unless
+``all_green``.
+
+Usage: python tools/bench_freshness.py [out.json]
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from lightgbm_tpu.analysis.budgets import check_freshness_budgets  # noqa: E402
+from lightgbm_tpu.faults import FaultInjector, FaultSpec  # noqa: E402
+from lightgbm_tpu.pipeline import (ArrivalFeed, RefreshDaemon,  # noqa: E402
+                                   SimClock)
+from lightgbm_tpu.serving.packed import PackedForest  # noqa: E402
+
+PARAMS = dict(objective="binary", num_leaves=15, learning_rate=0.1,
+              max_bin=63, min_data_in_leaf=5, verbose=-1, seed=7,
+              stream_block_rows=256)
+BLOCK_ROWS = 512
+NUM_FEATURES = 8
+REFRESH_ROUNDS = 4
+INITIAL_ROUNDS = 6
+CHECKPOINT_ROUNDS = 2
+SLO_MS = 30_000.0
+MODEL = "model"
+
+_FOREST_FIELDS = ("split_feature", "split_bin", "left", "right",
+                  "leaf_value", "is_leaf")
+
+
+def make_block(seed: int):
+    r = np.random.default_rng(seed)
+    X = r.normal(size=(BLOCK_ROWS, NUM_FEATURES)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] - 0.25 * X[:, 2] > 0).astype(np.float32)
+    return X, y
+
+
+def probe_rows(seed: int = 99, n: int = 64) -> np.ndarray:
+    r = np.random.default_rng(seed)
+    return r.normal(size=(n, NUM_FEATURES)).astype(np.float64)
+
+
+def build_daemon(state_dir, clock, *, injector=None, stage_costs=None,
+                 slo_ms=SLO_MS):
+    feed = ArrivalFeed(clock)
+    daemon = RefreshDaemon(
+        PARAMS, state_dir, feed=feed, model_name=MODEL,
+        refresh_rounds=REFRESH_ROUNDS, initial_rounds=INITIAL_ROUNDS,
+        checkpoint_rounds=CHECKPOINT_ROUNDS, staleness_slo_ms=slo_ms,
+        clock=clock, injector=injector, stage_costs=stage_costs)
+    return daemon, feed
+
+
+def artifacts_equal(path_a: str, path_b: str) -> bool:
+    a, b = PackedForest.load(path_a), PackedForest.load(path_b)
+    return all(np.array_equal(np.asarray(getattr(a, f)),
+                              np.asarray(getattr(b, f)))
+               for f in _FOREST_FIELDS)
+
+
+# ---------------------------------------------------------------------------
+# calibration: one REAL refresh generation on the wall clock; its
+# tracker decomposition becomes the sim clock's per-stage costs
+# ---------------------------------------------------------------------------
+
+def calibrate() -> dict:
+    with tempfile.TemporaryDirectory() as d:
+        daemon, feed = build_daemon(d, time.perf_counter)
+        feed.push(*make_block(0))
+        ev = daemon.tick()
+        assert ev is not None and ev["event"] == "flipped", ev
+        rec = daemon.tracker.record(1)
+        dec = rec.decomposition()
+    rounds = max(ev["rounds"], 1)
+    costs = {
+        "dataset_build": 0.0,  # folded into the measured train leg
+        "train_round": dec["train"] / rounds,
+        "publish": dec["publish"],
+        "deploy": dec["deploy"],
+        "flip": dec["flip"],
+    }
+    return {"provenance": "calibrated_sim_clock_real_stage_timings",
+            "measured_s": {k: round(v, 6) for k, v in dec.items()},
+            "rounds": rounds,
+            "stage_costs_s": {k: round(v, 6) for k, v in costs.items()},
+            "_costs": costs}
+
+
+# ---------------------------------------------------------------------------
+# scenario: multi-generation refresh loop, staleness + live traffic
+# ---------------------------------------------------------------------------
+
+def scenario_refresh_loop(costs: dict, generations: int = 4) -> dict:
+    clock = SimClock()
+    probe = probe_rows()
+    inflight = {"submitted": 0, "resolved": 0, "failed": 0}
+    with tempfile.TemporaryDirectory() as d:
+        daemon, feed = build_daemon(d, clock, stage_costs=costs)
+        batcher = None
+        events = []
+        for g in range(1, generations + 1):
+            feed.push(*make_block(g - 1))
+            clock.advance(0.25)  # daemon tick latency before pickup
+            pending = []
+            if batcher is not None:
+                # half the window submitted BEFORE the flip...
+                for row in probe[:8]:
+                    pending.append(batcher.submit(row))
+                batcher.pump()
+            ev = daemon.tick()
+            assert ev is not None and ev["event"] == "flipped", ev
+            events.append(ev)
+            if batcher is None:
+                batcher = daemon.bank.batcher(MODEL, max_batch=16,
+                                              max_delay_ms=1.0)
+            # ...and half after — all must resolve, none dropped
+            for row in probe[8:16]:
+                pending.append(batcher.submit(row))
+            batcher.flush()
+            for p in pending:
+                inflight["submitted"] += 1
+                try:
+                    p.result()
+                    inflight["resolved"] += 1
+                except Exception:                      # noqa: BLE001
+                    inflight["failed"] += 1
+        snap = daemon.tracker.snapshot()
+    gens = snap["generations"]
+    ok = (all(g["status"] == "serving" for g in gens)
+          and len(gens) == generations
+          and snap["breaches"] == []
+          and all(g["staleness_ms"] is not None
+                  and g["staleness_ms"] <= SLO_MS for g in gens)
+          and inflight["failed"] == 0
+          and inflight["resolved"] == inflight["submitted"])
+    return {"generations": gens,
+            "worst_staleness_ms": snap["worst_staleness_ms"],
+            "slo_ms": SLO_MS, "breaches": snap["breaches"],
+            "inflight": inflight, "ok": ok}
+
+
+# ---------------------------------------------------------------------------
+# chaos matrix
+# ---------------------------------------------------------------------------
+
+def _control_run(root: str, n_blocks: int) -> "RefreshDaemon":
+    """Unfaulted reference: one flip per block, wall-clock-free."""
+    daemon, feed = build_daemon(os.path.join(root, "control"), SimClock())
+    for g in range(n_blocks):
+        feed.push(*make_block(g))
+        ev = daemon.tick()
+        assert ev["event"] == "flipped", ev
+    return daemon
+
+
+def scenario_preemption(root: str, control) -> dict:
+    inj = FaultInjector()
+    daemon, feed = build_daemon(os.path.join(root, "preempt"), SimClock(),
+                                injector=inj)
+    feed.push(*make_block(0))
+    assert daemon.tick()["event"] == "flipped"
+    # rounds 7..10 consult continue_train once each; hit counts are
+    # global per site, so arm RELATIVE to generation 1's consumption:
+    # +2 fires at round 9, AFTER the cadence checkpoint at round 8
+    # landed — the retry must resume from that checkpoint, not restart
+    inj.arm(FaultSpec(site="continue_train",
+                      after=inj.hits["continue_train"] + 2, times=1))
+    feed.push(*make_block(1))
+    first = daemon.tick()
+    second = daemon.tick()
+    rec = daemon.tracker.record(2)
+    same = artifacts_equal(daemon._live_path, control._live_path)
+    resumed_ckpt = bool(second.get("resumed_from", "")
+                        and str(second["resumed_from"]).endswith(".lgckpt"))
+    ok = (first["event"] == "preempted" and second["event"] == "flipped"
+          and resumed_ckpt and rec.attempts == 2 and same)
+    return {"first_attempt": first["event"],
+            "retry": second["event"],
+            "resumed_from_checkpoint": resumed_ckpt,
+            "attempts": rec.attempts,
+            "flip_bit_identical_to_unpreempted": same, "ok": ok}
+
+
+def scenario_corrupt_artifact(root: str, control) -> dict:
+    inj = FaultInjector()
+    probe = probe_rows()
+    daemon, feed = build_daemon(os.path.join(root, "corrupt"), SimClock(),
+                                injector=inj)
+    feed.push(*make_block(0))
+    assert daemon.tick()["event"] == "flipped"
+    before = daemon.bank.predict(MODEL, probe)
+    inj.arm(FaultSpec(site="artifact_push", after=0, times=1))
+    feed.push(*make_block(1))
+    rejected = daemon.tick()
+    still_v1 = daemon.bank.version(MODEL) == "g0001"
+    after = daemon.bank.predict(MODEL, probe)
+    retry = daemon.tick()
+    same = artifacts_equal(daemon._live_path, control._live_path)
+    ok = (rejected["event"] == "rejected" and rejected["poisoned"]
+          and still_v1 and np.array_equal(before, after)
+          and retry["event"] == "flipped"
+          and daemon.bank.version(MODEL) == "g0002" and same)
+    return {"event": rejected["event"],
+            "rejected_stage": rejected.get("stage"),
+            "prior_version_kept_serving": still_v1,
+            "prior_predictions_bit_identical": bool(
+                np.array_equal(before, after)),
+            "retry": retry["event"],
+            "clean_retry_bit_identical_to_control": same, "ok": ok}
+
+
+def scenario_canary_fault(root: str) -> dict:
+    inj = FaultInjector()
+    daemon, feed = build_daemon(os.path.join(root, "canary"), SimClock(),
+                                injector=inj)
+    feed.push(*make_block(0))
+    assert daemon.tick()["event"] == "flipped"
+    # warm_on_deploy is off, so the canary batch is the next
+    # device_predict dispatch — the fault fires inside the canary and
+    # the deploy must reject at exactly that stage
+    inj.arm(FaultSpec(site="device_predict", after=0, times=1))
+    feed.push(*make_block(1))
+    rejected = daemon.tick()
+    still_v1 = daemon.bank.version(MODEL) == "g0001"
+    retry = daemon.tick()
+    ok = (rejected["event"] == "rejected"
+          and rejected.get("stage") == "canary" and still_v1
+          and retry["event"] == "flipped")
+    return {"event": rejected["event"],
+            "rejected_stage": rejected.get("stage"),
+            "prior_version_kept_serving": still_v1,
+            "retry": retry["event"], "ok": ok}
+
+
+def scenario_rollback(root: str) -> dict:
+    inj = FaultInjector()
+    probe = probe_rows()
+    daemon, feed = build_daemon(os.path.join(root, "rollback"), SimClock(),
+                                injector=inj)
+    feed.push(*make_block(0))
+    assert daemon.tick()["event"] == "flipped"
+    before = daemon.bank.predict(MODEL, probe)
+    inj.arm(FaultSpec(site="flip", after=0, times=1))
+    feed.push(*make_block(1))
+    rolled = daemon.tick()
+    reverted = daemon.bank.version(MODEL) == "g0001"
+    after = daemon.bank.predict(MODEL, probe)
+    # the NEXT generation re-anchors on the reverted model
+    feed.push(*make_block(2))
+    nxt = daemon.tick()
+    ok = (rolled["event"] == "rolled_back" and reverted
+          and np.array_equal(before, after)
+          and nxt["event"] == "flipped"
+          and daemon.bank.version(MODEL) == "g0003"
+          and daemon._live_rounds == INITIAL_ROUNDS + REFRESH_ROUNDS)
+    return {"event": rolled["event"],
+            "reverted_to_prior_version": reverted,
+            "prior_predictions_bit_identical": bool(
+                np.array_equal(before, after)),
+            "next_generation": nxt["event"],
+            "reanchored_rounds": daemon._live_rounds, "ok": ok}
+
+
+# ---------------------------------------------------------------------------
+# streamed continuation parity (the lifted fence, both codecs)
+# ---------------------------------------------------------------------------
+
+def scenario_continuation_parity() -> dict:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.dataset import Dataset
+    from lightgbm_tpu.models.gbdt import Booster
+
+    X, y = make_block(0)
+    X2, y2 = make_block(1)
+    blocks = [(X, y), (X2, y2)]
+
+    def ds():
+        return Dataset.from_blocks(blocks, params=dict(PARAMS))
+
+    ref = lgb.train(PARAMS, ds(), num_boost_round=6)
+    base = lgb.train(PARAMS, ds(), num_boost_round=4)
+    out = {}
+    with tempfile.TemporaryDirectory() as d:
+        for codec, name in (("txt", "m.txt"), ("npz", "m.npz")):
+            path = os.path.join(d, name)
+            if codec == "npz":
+                from lightgbm_tpu.serving.packed import pack_booster
+                pack_booster(base).save(path)
+            else:
+                base.save_model(path)
+            cont = Booster(model_file=path)
+            dsc = ds()
+            for _ in range(2):
+                cont.update(train_set=dsc)
+                dsc = None
+            same = (len(cont.trees) == len(ref.trees) and all(
+                np.array_equal(np.asarray(getattr(a, f)),
+                               np.asarray(getattr(b, f)))
+                for a, b in zip(ref.trees, cont.trees)
+                for f in _FOREST_FIELDS))
+            out[f"{codec}_bit_identical"] = bool(same)
+    out["ok"] = out["txt_bit_identical"] and out["npz_bit_identical"]
+    return out
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 \
+        else "BENCH_FRESHNESS_r15.json"
+    import jax
+
+    cal = calibrate()
+    costs = cal.pop("_costs")
+    refresh = scenario_refresh_loop(costs)
+
+    root = tempfile.mkdtemp(prefix="bench_freshness_")
+    try:
+        control = _control_run(root, 2)
+        preempt = scenario_preemption(root, control)
+        corrupt = scenario_corrupt_artifact(root, control)
+        canary = scenario_canary_fault(root)
+        rollback = scenario_rollback(root)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    parity = scenario_continuation_parity()
+    budgets = check_freshness_budgets()
+
+    acceptance = {
+        "staleness_measured_under_slo": refresh["ok"],
+        "zero_dropped_inflight_across_flips":
+            refresh["inflight"]["failed"] == 0
+            and refresh["inflight"]["resolved"]
+            == refresh["inflight"]["submitted"],
+        "chaos_preemption_converges_bit_identical": preempt["ok"],
+        "chaos_corrupt_artifact_rejected_prior_serving": corrupt["ok"],
+        "chaos_canary_fault_rejected_prior_serving": canary["ok"],
+        "chaos_rollback_bit_identical_prior": rollback["ok"],
+        "streamed_continuation_bit_identical": parity["ok"],
+        "freshness_budgets_ok": all(r["ok"] for r in budgets),
+    }
+    acceptance["all_green"] = all(acceptance.values())
+
+    doc = {
+        "bench": "freshness_pipeline",
+        "round": 15,
+        "backend": jax.default_backend(),
+        "n_devices": jax.device_count(),
+        "shape": {"block_rows": BLOCK_ROWS,
+                  "num_features": NUM_FEATURES,
+                  "refresh_rounds": REFRESH_ROUNDS,
+                  "initial_rounds": INITIAL_ROUNDS,
+                  "checkpoint_rounds": CHECKPOINT_ROUNDS,
+                  "slo_ms": SLO_MS},
+        "calibration": cal,
+        "refresh_loop": refresh,
+        "chaos_preemption": preempt,
+        "chaos_corrupt_artifact": corrupt,
+        "chaos_canary_fault": canary,
+        "chaos_rollback": rollback,
+        "continuation_parity": parity,
+        "freshness_budgets": budgets,
+        "acceptance_r15": acceptance,
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(json.dumps(acceptance, indent=1))
+    print(f"-> {out_path}")
+    return 0 if acceptance["all_green"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

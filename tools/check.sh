@@ -81,7 +81,20 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py \
   tests/test_training_chaos.py -q \
   -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
-# 8. trace-level budgets (slow lane)
+# 8. freshness: the r15 production loop — streamed model-file
+#    continuation bit-identity (the lifted fence, both codecs) with
+#    schema-digest enforcement, Dataset.from_blocks(reference=) schema
+#    pinning, the RefreshDaemon train -> publish -> canary -> flip loop
+#    on the sim clock with exact staleness decomposition, chaos at the
+#    pipeline fault sites (preemption resume, corrupt artifact push,
+#    rollback, poll outage), restart re-anchoring, and the task=refresh
+#    CLI contract.  The staleness budget models already ran in the
+#    graftlint layer above (freshness section).
+echo "== freshness (refresh pipeline + staleness SLO) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_freshness.py -q \
+  -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# 9. trace-level budgets (slow lane)
 if [ "$full" = 1 ]; then
   echo "== budgets + recompile sweeps =="
   JAX_PLATFORMS=cpu python -m lightgbm_tpu lint --budgets -q
