@@ -1416,3 +1416,96 @@ def check_freshness_budgets(names: Optional[List[str]] = None
     specs = (FRESHNESS_BUDGETS if names is None
              else [freshness_budget_by_name(n) for n in names])
     return [b.check() for b in specs]
+
+
+# ---------------------------------------------------------------------------
+# budget anchors — Layer-2 stale-entry reporting (r16)
+# ---------------------------------------------------------------------------
+# Every budget family above models a REAL entry point; rename that
+# function (or delete its module) and the budget silently keeps passing
+# against nothing.  The anchors pin each spec section to the live
+# symbols it models, checked with pure ``ast`` in the default lint pass
+# (no JAX import, no execution) — a renamed anchor is a lint failure,
+# not a silent no-op.
+
+BUDGET_ANCHORS: Dict[str, Tuple[Tuple[str, str], ...]] = {
+    # section -> ((repo-relative file, top-level symbol), ...)
+    "launch": (
+        ("lightgbm_tpu/models/tree.py", "grow_tree"),
+        ("lightgbm_tpu/models/fused.py", "run_fused_cv_batch"),
+        ("lightgbm_tpu/ops/split.py", "SplitContext"),
+    ),
+    "comm": (
+        ("lightgbm_tpu/parallel/feature_parallel.py",
+         "reduce_best_split"),
+    ),
+    "stream": (
+        ("lightgbm_tpu/data/block_store.py", "BlockStore"),
+        ("lightgbm_tpu/data/stream_grow.py", "stream_goss_round"),
+    ),
+    "serve_slo": (
+        ("lightgbm_tpu/serving/runtime.py", "PredictorRuntime"),
+        ("lightgbm_tpu/serving/packed.py", "PackedForest"),
+        ("lightgbm_tpu/serving/queue.py", "MicroBatcher"),
+        ("lightgbm_tpu/serving/mesh.py", "choose_route"),
+        ("lightgbm_tpu/serving/mesh.py", "ServingMesh"),
+        ("lightgbm_tpu/ops/quantize.py", "wire_transfer"),
+        ("lightgbm_tpu/ops/quantize.py", "models_per_byte_gain"),
+        ("lightgbm_tpu/ops/quantize.py", "packed_model_bytes"),
+    ),
+    "ckpt": (
+        ("lightgbm_tpu/training/checkpoint.py", "save_checkpoint"),
+        ("lightgbm_tpu/training/checkpoint.py", "load_latest"),
+    ),
+    "freshness": (
+        ("lightgbm_tpu/pipeline/daemon.py", "RefreshDaemon"),
+        ("lightgbm_tpu/pipeline/staleness.py", "StalenessTracker"),
+    ),
+}
+
+
+def _top_level_symbols(path: str) -> Optional[set]:
+    """Top-level def/class names of ``path``, or None when unreadable."""
+    import ast as _ast
+    import os as _os
+
+    if not _os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        try:
+            tree = _ast.parse(f.read())
+        except SyntaxError:
+            return None
+    return {n.name for n in tree.body
+            if isinstance(n, (_ast.FunctionDef, _ast.AsyncFunctionDef,
+                              _ast.ClassDef))}
+
+
+def check_budget_anchors(anchors: Optional[Dict[str, Tuple]] = None
+                         ) -> List[Dict[str, object]]:
+    """One result dict per anchored symbol; ``ok=False`` means the
+    budget section references a dead file or renamed symbol."""
+    import os as _os
+
+    repo_root = _os.path.dirname(_os.path.dirname(
+        _os.path.dirname(_os.path.abspath(__file__))))
+    out: List[Dict[str, object]] = []
+    cache: Dict[str, Optional[set]] = {}
+    for section, pins in sorted((anchors or BUDGET_ANCHORS).items()):
+        for rel, symbol in pins:
+            path = _os.path.join(repo_root, rel.replace("/", _os.sep))
+            if rel not in cache:
+                cache[rel] = _top_level_symbols(path)
+            syms = cache[rel]
+            if syms is None:
+                ok, why = False, f"{rel}: file missing or unparseable"
+            elif symbol not in syms:
+                ok, why = False, (f"`{symbol}` not found at top level of "
+                                  f"{rel} — renamed or deleted; update "
+                                  f"the budget spec's anchor")
+            else:
+                ok, why = True, ""
+            out.append({"name": f"{section}:{symbol}", "section": section,
+                        "path": rel, "symbol": symbol, "ok": ok,
+                        "why": why})
+    return out

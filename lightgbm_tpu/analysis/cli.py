@@ -1,10 +1,19 @@
 """``python -m lightgbm_tpu lint`` — the graftlint front end.
 
-Default run: Layer 1 (AST rules + baseline) and the VMEM estimates —
-fast, no compilation.  ``--budgets`` adds the Layer-2 HLO launch budgets
-and the zero-recompile sweeps (lowers real entry points; ~a minute on
-CPU).  Exit codes: 0 clean, 1 findings/budget violations, 2 usage or
-baseline-format errors.
+Default run: Layer 1 (AST rules + baseline, whole-program in the
+no-paths case) plus the pure-arithmetic Layer-2 checks (VMEM estimates,
+budget models, budget anchors) — fast, no compilation.  ``--budgets``
+adds the HLO launch budgets and the zero-recompile sweeps (lowers real
+entry points; ~a minute on CPU).
+
+Exit codes (machine-readable by construction):
+
+* 0 — clean;
+* 1 — findings above the baseline / budget violations;
+* 2 — usage or baseline-format error (``graftlint: usage-error: ...``);
+* 3 — internal analyzer error (``graftlint: internal-error: ...``) —
+  the analyzer itself broke, which must never masquerade as "the tree
+  has findings" in CI.
 """
 
 from __future__ import annotations
@@ -25,11 +34,28 @@ options:
   --no-baseline     report accepted debt too (ratchet view)
   --baseline PATH   alternate baseline file
   --format json     machine-readable report on stdout
+  --format github   GitHub workflow-annotation lines (::error file=...)
   -q, --quiet       findings only, no summary
 """
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    """Parse args and run; every internal failure becomes exit 3 with a
+    typed one-liner (the r15 CLI convention: no tracebacks)."""
+    try:
+        return _run(argv)
+    except SystemExit:
+        raise
+    except BaselineError as e:
+        print(f"graftlint: usage-error: {e}", file=sys.stderr)
+        return 2
+    except Exception as e:  # noqa: BLE001 — the exit-3 contract boundary
+        print(f"graftlint: internal-error: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 3
+
+
+def _run(argv: Optional[List[str]] = None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
     budgets, vmem = False, True
     use_baseline = True
@@ -57,8 +83,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             baseline_path = args[i]
         elif a == "--format":
             i += 1
-            if i >= len(args) or args[i] not in ("text", "json"):
-                print("--format takes text|json", file=sys.stderr)
+            if i >= len(args) or args[i] not in ("text", "json",
+                                                 "github"):
+                print("--format takes text|json|github",
+                      file=sys.stderr)
                 return 2
             fmt = args[i]
         elif a in ("-q", "--quiet"):
@@ -70,12 +98,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             paths.append(a)
         i += 1
 
-    try:
-        report = run_lint(paths or None,
-                          baseline_path if use_baseline else None)
-    except BaselineError as e:
-        print(f"graftlint: {e}", file=sys.stderr)
-        return 2
+    report = run_lint(paths or None,
+                      baseline_path if use_baseline else None)
 
     sections = {"layer1": {
         "files_checked": report.files_checked,
@@ -123,6 +147,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     sections["freshness"] = res
     failed |= any(not r["ok"] for r in res)
 
+    # Layer-2 stale-entry reporting: budget specs must anchor to live
+    # symbols — pure ast, so it rides in the default pass
+    from .budgets import check_budget_anchors
+
+    res = check_budget_anchors()
+    sections["budget_anchors"] = res
+    failed |= any(not r["ok"] for r in res)
+
     if budgets:
         from .budgets import check_launch_budgets, check_recompile_specs
 
@@ -138,6 +170,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(json.dumps(sections, indent=1))
         return 1 if failed else 0
 
+    if fmt == "github":
+        # workflow-annotation lines: findings anchor file+line, budget /
+        # anchor failures annotate without a location
+        for f in report.unsuppressed:
+            print(f"::error file={f.path},line={f.line},"
+                  f"col={f.col + 1},title=graftlint {f.rule}::"
+                  f"{f.message}")
+        for line in sections["layer1"]["stale_suppressions"]:
+            print(f"::warning title=graftlint stale baseline::{line}")
+        for key, rs in sections.items():
+            if key == "layer1":
+                continue
+            for r in rs:
+                if not r["ok"]:
+                    why = r.get("why") or json.dumps(
+                        {k: v for k, v in r.items() if k != "name"})
+                    print(f"::error title=graftlint {key}::"
+                          f"{r['name']}: {why}")
+        return 1 if failed else 0
+
     l1 = sections["layer1"]
     for line in l1["unsuppressed"]:
         print(line)
@@ -145,8 +197,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         for line in l1["stale_suppressions"]:
             print(f"stale baseline entry: {line}")
         for key in ("vmem", "comm_budgets", "comm_time", "stream_time",
-                    "serve_slo", "ckpt", "freshness", "launch_budgets",
-                    "recompile"):
+                    "serve_slo", "ckpt", "freshness", "budget_anchors",
+                    "launch_budgets", "recompile"):
             for r in sections.get(key, ()):
                 mark = "ok" if r["ok"] else "FAIL"
                 detail = (f"{r['estimated_mb']}/{r['budget_mb']} MB"
@@ -159,6 +211,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                           f"{r['comm_ms']:.3f} ms, floor "
                           f"{r['budget']*100:.0f}%)"
                           if key in ("comm_time", "stream_time") else
+                          f"{r['path']}" + (f" ({r['why']})"
+                                            if r["why"] else "")
+                          if key == "budget_anchors" else
                           f"{r.get('measured', r.get('compiles'))}"
                           f"/{r.get('budget', r.get('max_compiles'))}")
                 print(f"[{mark}] {key}:{r['name']} {detail}")

@@ -15,6 +15,7 @@ from typing import Iterable, List, Optional
 
 from .baseline import (BaselineResult, Suppression, apply_baseline,
                        parse_baseline)
+from .program import Program, fault_site_findings
 from .rules import Finding, analyze_source
 
 # Directories never linted: fixtures are deliberately-broken snippets,
@@ -63,26 +64,53 @@ class LintReport:
         return not self.unsuppressed
 
 
-def run_lint(paths: Optional[Iterable[str]] = None,
-             baseline_path: Optional[str] = DEFAULT_BASELINE) -> LintReport:
-    """Lint ``paths`` (default: the installed package tree) and fold in
-    the baseline.  ``baseline_path=None`` disables suppression."""
-    if paths is None:
-        paths = [PACKAGE_ROOT]
-    report = LintReport()
+def _read_sources(paths: Iterable[str]) -> List[tuple]:
+    out = []
     for path in iter_py_files(paths):
         with open(path, encoding="utf-8") as f:
-            src = f.read()
-        report.findings.extend(analyze_source(rel_path(path), src))
-        report.files_checked += 1
+            out.append((rel_path(path), f.read()))
+    return out
+
+
+def run_lint(paths: Optional[Iterable[str]] = None,
+             baseline_path: Optional[str] = DEFAULT_BASELINE) -> LintReport:
+    """Lint ``paths`` and fold in the baseline.
+
+    With no explicit ``paths`` (the default pass) the whole package is
+    analyzed as one :class:`~.program.Program`: traced/kernel closure
+    crosses module boundaries and GL010 checks the fault-site registry
+    against every consultation site and the chaos-test tree.  Explicit
+    paths keep the r8 per-file behavior (fixtures, CLI-on-a-file) —
+    cross-module rules need the whole program and are skipped there.
+
+    ``baseline_path=None`` disables suppression.  GL000 parse failures
+    are never baselined and never waived: a tree that does not parse
+    fails the gate, full stop.
+    """
+    report = LintReport()
+    if paths is None:
+        modules = _read_sources([PACKAGE_ROOT])
+        program = Program(modules)
+        report.findings.extend(program.run_rules())
+        tests_dir = os.path.join(REPO_ROOT, "tests")
+        test_sources = (_read_sources([tests_dir])
+                        if os.path.isdir(tests_dir) else [])
+        report.findings.extend(fault_site_findings(program, test_sources))
+        report.files_checked = len(modules)
+    else:
+        for rel, src in _read_sources(paths):
+            report.findings.extend(analyze_source(rel, src))
+            report.files_checked += 1
     report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
 
     suppressions: List[Suppression] = []
     if baseline_path and os.path.exists(baseline_path):
         with open(baseline_path, encoding="utf-8") as f:
             suppressions = parse_baseline(f.read())
-    res: BaselineResult = apply_baseline(report.findings, suppressions)
-    report.unsuppressed = res.unsuppressed
+    parse_failures = [f for f in report.findings if f.rule == "GL000"]
+    rest = [f for f in report.findings if f.rule != "GL000"]
+    res: BaselineResult = apply_baseline(rest, suppressions)
+    report.unsuppressed = parse_failures + res.unsuppressed
     report.suppressed = res.suppressed
     report.stale = res.stale
     return report

@@ -43,6 +43,7 @@ from __future__ import annotations
 import os
 import re
 import shutil
+import threading
 from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -208,6 +209,9 @@ class RefreshDaemon:
         self.tracker = StalenessTracker(slo_ms=staleness_slo_ms)
         self.poll_faults = 0
 
+        # guards the absorb-state (blocks/pending/retry/generation/live
+        # pointers) against status()/snapshot() readers on other threads
+        self._lock = threading.RLock()
         self._blocks: List[Tuple[np.ndarray, np.ndarray]] = []
         self._pending: List[Arrival] = []
         self._retry = False
@@ -242,9 +246,11 @@ class RefreshDaemon:
                 # cannot lose already-delivered arrivals
                 self.injector.check("data_arrival")
             except FaultError as e:
-                self.poll_faults += 1
+                with self._lock:
+                    self.poll_faults += 1
                 return {"event": "poll_fault", "error": str(e)}
-        self._pending.extend(self.feed.poll())
+        with self._lock:
+            self._pending.extend(self.feed.poll())
         if not self._pending and not self._retry:
             return None
         return self._run_refresh()
@@ -281,7 +287,8 @@ class RefreshDaemon:
         ds = Dataset.from_blocks(blocks, params=dict(self.params),
                                  reference=self._ref_mapper)
         if self._ref_mapper is None:
-            self._ref_mapper = ds.bin_mapper
+            with self._lock:
+                self._ref_mapper = ds.bin_mapper
         self._charge("dataset_build")
 
         target = self._live_rounds + (self.refresh_rounds
@@ -304,13 +311,15 @@ class RefreshDaemon:
         except FaultError as e:
             rec.status = "preempted"
             rec.error = str(e)
-            self._retry = True
+            with self._lock:
+                self._retry = True
             return {"event": "preempted", "generation": gen,
                     "error": str(e)}
         if res.preempted or not res.completed:
             rec.status = "preempted"
             rec.error = "SIGTERM drain mid-refresh"
-            self._retry = True
+            with self._lock:
+                self._retry = True
             return {"event": "preempted", "generation": gen,
                     "error": rec.error}
         rec.rounds = res.rounds_done
@@ -328,7 +337,8 @@ class RefreshDaemon:
         except SwapRejected as e:
             rec.status = "rejected"
             rec.error = f"{e.stage}: {e}"
-            self._retry = True
+            with self._lock:
+                self._retry = True
             return {"event": "rejected", "generation": gen,
                     "stage": e.stage, "poisoned": poisoned,
                     "error": str(e)}
@@ -346,8 +356,8 @@ class RefreshDaemon:
                 rb = None
                 try:
                     rb = self.bank.rollback(self.model_name)
-                except SwapRejected:
-                    pass  # generation 1: nothing to roll back to
+                except SwapRejected:  # graftlint: GL011 — gen 1: no prior
+                    pass
                 rec.status = "rolled_back"
                 rec.error = str(e)
                 self._absorb(gen)
@@ -359,7 +369,8 @@ class RefreshDaemon:
         rec.status = "serving"
         rec.version = version
         self._absorb(gen)
-        self._live_path, self._live_rounds = art, res.rounds_done
+        with self._lock:
+            self._live_path, self._live_rounds = art, res.rounds_done
         shutil.rmtree(self._ckpt_dir(gen), ignore_errors=True)
         self._prune_artifacts()
         return {"event": "flipped", "generation": gen,
@@ -372,10 +383,11 @@ class RefreshDaemon:
         """Commit the pending arrivals + generation number (the data was
         trained into generation ``gen`` whether it ended up serving or
         quarantined by a rollback)."""
-        self._blocks.extend((a.X, a.y) for a in self._pending)
-        self._pending = []
-        self._retry = False
-        self._gen = gen
+        with self._lock:
+            self._blocks.extend((a.X, a.y) for a in self._pending)
+            self._pending = []
+            self._retry = False
+            self._gen = gen
 
     def _publish(self, booster, art: str) -> bool:
         """Atomically write the versioned artifact (tmp + rename, the

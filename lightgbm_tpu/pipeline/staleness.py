@@ -169,5 +169,7 @@ class SimClock:
 
 
 def wall_clock() -> float:
-    """Default daemon clock (real deployments)."""
-    return time.monotonic()
+    """Default daemon clock (real deployments) — the ONE sanctioned
+    wall-clock boundary in the pipeline; everything downstream takes an
+    injected ``clock=``."""
+    return time.monotonic()  # graftlint: GL008 — the injection boundary

@@ -55,6 +55,7 @@ traffic.
 from __future__ import annotations
 
 import json
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -160,6 +161,9 @@ class ModelBank:
         self.persistent_cache = (enable_persistent_cache(cache_dir)
                                  if cache_dir else False)
         self.cache_dir = cache_dir
+        # guards the resident-version table: deploys flip and undeploys
+        # delete while reader threads (MicroBatcher resolvers) look up
+        self._lock = threading.RLock()
         self._entries: Dict[str, _ModelEntry] = {}
 
     # -- lookup --------------------------------------------------------------
@@ -256,13 +260,14 @@ class ModelBank:
         n = (entry.n_deploys if entry is not None else 0) + 1
         ver = version if version is not None else f"v{n}"
         new = _ModelVersion(rt, packed, ver, path)
-        if entry is None:
-            entry = _ModelEntry(name=name, stats=stats, active=new)
-            self._entries[name] = entry
-        else:
-            entry.previous = entry.active
-            entry.active = new
-        entry.n_deploys = n
+        with self._lock:
+            if entry is None:
+                entry = _ModelEntry(name=name, stats=stats, active=new)
+                self._entries[name] = entry
+            else:
+                entry.previous = entry.active
+                entry.active = new
+            entry.n_deploys = n
         # the stats object survives the swap; point its compile-cache
         # view at the ACTIVE runtime (PredictorRuntime.__init__ attached
         # the new one already — this is documentation of that fact)
@@ -274,12 +279,14 @@ class ModelBank:
     def rollback(self, name: str) -> dict:
         """Flip back to the previous resident version (instant: its
         runtime and compiled programs never went away)."""
-        entry = self._entry(name)
-        if entry.previous is None:
-            raise SwapRejected("rollback",
-                               f"model {name!r} has no previous version")
-        entry.active, entry.previous = entry.previous, entry.active
-        entry.stats.attach_cache(entry.active.runtime.cache_info)
+        with self._lock:
+            entry = self._entry(name)
+            if entry.previous is None:
+                raise SwapRejected(
+                    "rollback",
+                    f"model {name!r} has no previous version")
+            entry.active, entry.previous = entry.previous, entry.active
+            entry.stats.attach_cache(entry.active.runtime.cache_info)
         report = {"model": name, "ok": True, "stage": "rolled_back",
                   "version": entry.active.version,
                   "previous_version": entry.previous.version}
@@ -287,8 +294,9 @@ class ModelBank:
         return report
 
     def undeploy(self, name: str) -> None:
-        self._entry(name)
-        del self._entries[name]
+        with self._lock:
+            self._entry(name)
+            del self._entries[name]
 
     # -- deploy internals ----------------------------------------------------
     def _ingest(self, source):

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import threading
 import time
 from collections import deque
 from typing import Optional
@@ -153,6 +154,10 @@ class MicroBatcher:
         self._ewma_dispatch_s = (0.0 if service_time_hint_ms is None
                                  else float(service_time_hint_ms) / 1e3)
         self.stats = self.runtime.stats
+        # RLock: pump()/flush() hold it across the helpers below, and
+        # each helper re-enters so every queue mutation is lock-guarded
+        # even when the embedder calls a helper path directly
+        self._lock = threading.RLock()
         self._q: "deque[_QueuedRequest]" = deque()
         self._exp_heap: list = []            # (deadline, seq, request)
         self._seq = itertools.count()
@@ -184,17 +189,19 @@ class MicroBatcher:
         tmo = self.timeout_ms if timeout_ms is None else timeout_ms
         deadline = None if tmo is None else now + float(tmo) / 1e3
         self.stats.record_request()
-        shed_why = self._admission_check(now, deadline)
-        if shed_why is not None:
-            pending._set(error=Overloaded(shed_why))
-            self.stats.record_shed()
-            return pending
-        req = _QueuedRequest(row, pending, now, deadline, num_iteration)
-        self._q.append(req)
-        self._live += 1
-        if deadline is not None:
-            heapq.heappush(self._exp_heap,
-                           (deadline, next(self._seq), req))
+        with self._lock:
+            shed_why = self._admission_check(now, deadline)
+            if shed_why is not None:
+                pending._set(error=Overloaded(shed_why))
+                self.stats.record_shed()
+                return pending
+            req = _QueuedRequest(row, pending, now, deadline,
+                                 num_iteration)
+            self._q.append(req)
+            self._live += 1
+            if deadline is not None:
+                heapq.heappush(self._exp_heap,
+                               (deadline, next(self._seq), req))
         return pending
 
     def _admission_check(self, now: float,
@@ -234,64 +241,71 @@ class MicroBatcher:
         """One scheduler step: expire overdue requests, dispatch due
         batches.  Returns the number of batches dispatched."""
         now = self.clock()
-        self._expire(now)
-        dispatched = 0
-        # full batches always go, regardless of delay
-        while self._live >= self.max_batch:
-            self._dispatch(self._take(self.max_batch), now)
-            dispatched += 1
-        # short batch goes once the oldest request has waited long enough
-        self._drop_settled_head()
-        if self._q and (now - self._q[0].enqueued_at) >= self.max_delay_s:
-            self._dispatch(self._take(self._live), now)
-            dispatched += 1
+        with self._lock:
+            self._expire(now)
+            dispatched = 0
+            # full batches always go, regardless of delay
+            while self._live >= self.max_batch:
+                self._dispatch(self._take(self.max_batch), now)
+                dispatched += 1
+            # short batch goes once the oldest request has waited long
+            # enough
+            self._drop_settled_head()
+            if self._q and (now - self._q[0].enqueued_at) >= \
+                    self.max_delay_s:
+                self._dispatch(self._take(self._live), now)
+                dispatched += 1
         return dispatched
 
     def flush(self) -> int:
         """Dispatch everything still queued (shutdown / end-of-stream)."""
         now = self.clock()
-        self._expire(now)
-        dispatched = 0
-        while self._live:
-            self._dispatch(self._take(min(self._live, self.max_batch)),
-                           now)
-            dispatched += 1
-        self._q.clear()
-        self._exp_heap.clear()
+        with self._lock:
+            self._expire(now)
+            dispatched = 0
+            while self._live:
+                self._dispatch(
+                    self._take(min(self._live, self.max_batch)), now)
+                dispatched += 1
+            self._q.clear()
+            self._exp_heap.clear()
         return dispatched
 
     # -- internals -----------------------------------------------------------
     def _take(self, k: int):
         out = []
-        while self._q and len(out) < k:
-            r = self._q.popleft()
-            if r.state == _QUEUED:
-                r.state = _TAKEN
-                self._live -= 1
-                out.append(r)
+        with self._lock:
+            while self._q and len(out) < k:
+                r = self._q.popleft()
+                if r.state == _QUEUED:
+                    r.state = _TAKEN
+                    self._live -= 1
+                    out.append(r)
         return out
 
     def _drop_settled_head(self) -> None:
         # expired/taken tombstones at the head are dead; each is popped
         # at most once over its lifetime
-        while self._q and self._q[0].state != _QUEUED:
-            self._q.popleft()
+        with self._lock:
+            while self._q and self._q[0].state != _QUEUED:
+                self._q.popleft()
 
     def _expire(self, now: float) -> None:
         # heap-ordered eviction: pop only the requests whose deadline has
         # actually passed — bounded per flush by the expired count, not
         # the queue length
         expired = 0
-        while self._exp_heap and self._exp_heap[0][0] < now:
-            _, _, r = heapq.heappop(self._exp_heap)
-            if r.state != _QUEUED:
-                continue                       # already dispatched
-            r.state = _EXPIRED
-            self._live -= 1
-            r.pending._set(error=RequestTimeout(
-                f"request expired after "
-                f"{(now - r.enqueued_at) * 1e3:.1f} ms in queue"))
-            expired += 1
+        with self._lock:
+            while self._exp_heap and self._exp_heap[0][0] < now:
+                _, _, r = heapq.heappop(self._exp_heap)
+                if r.state != _QUEUED:
+                    continue                   # already dispatched
+                r.state = _EXPIRED
+                self._live -= 1
+                r.pending._set(error=RequestTimeout(
+                    f"request expired after "
+                    f"{(now - r.enqueued_at) * 1e3:.1f} ms in queue"))
+                expired += 1
         if expired:
             self.stats.record_timeout(expired)
 
@@ -323,9 +337,10 @@ class MicroBatcher:
             # EWMA of dispatch time feeds the deadline shed predictor;
             # measured through the injectable clock so mocked-clock tests
             # (dt == 0) keep the model inactive
-            self._ewma_dispatch_s = (dt if self._ewma_dispatch_s <= 0.0
-                                     else 0.7 * self._ewma_dispatch_s
-                                     + 0.3 * dt)
+            with self._lock:
+                self._ewma_dispatch_s = (
+                    dt if self._ewma_dispatch_s <= 0.0
+                    else 0.7 * self._ewma_dispatch_s + 0.3 * dt)
 
     def _fallback(self, runtime, group, num_it) -> None:
         """Device dispatch failed: unbatched CPU predict per request.

@@ -87,7 +87,7 @@ def enable_persistent_cache(cache_dir: str) -> bool:
                      ("jax_persistent_cache_min_entry_size_bytes", -1)):
         try:
             jax.config.update(key, val)
-        except Exception:                      # noqa: BLE001
+        except Exception:  # noqa: BLE001  # graftlint: GL011 — older jax
             pass                               # older jax: defaults apply
     return True
 
@@ -123,7 +123,8 @@ class PredictorRuntime:
                  faults=None,
                  mesh_devices: int = 1,
                  shard_policy: str = "auto",
-                 forest_precision: str = "f32"):
+                 forest_precision: str = "f32",
+                 clock=time.perf_counter):
         import jax
 
         if max_bucket < 1 or (max_bucket & (max_bucket - 1)):
@@ -141,6 +142,9 @@ class PredictorRuntime:
         self.max_cache_entries = int(max_cache_entries)
         self.stats = stats if stats is not None else ServingStats()
         self.faults = faults
+        # injectable latency source (r12 clock contract) — pass
+        # ``faults.wrap_clock(...)`` here to skew it deterministically
+        self.clock = clock
         self.shard_policy = shard_policy
         self.forest_precision = forest_precision
         self._donate = (jax.default_backend() == "tpu"
@@ -273,7 +277,7 @@ class PredictorRuntime:
 
         if self.faults is not None:
             self.faults.check("device_predict")   # may raise FaultError
-        t0 = time.perf_counter()
+        t0 = self.clock()
         n = codes.shape[0]
         bucket = bucket_for(n, self.max_bucket)
         pad = bucket - n
@@ -288,7 +292,7 @@ class PredictorRuntime:
                             jnp.int32(k)))
         self.stats.record_dispatch(
             bucket, rows=n, padded=pad,
-            latency_s=time.perf_counter() - t0, route=route)
+            latency_s=self.clock() - t0, route=route)
         return out[:n]
 
     def _get_fn(self, bucket: int, raw_score: bool,

@@ -12,12 +12,15 @@ Three layers of coverage:
   guarantees hold (serving bucket ladder, fused train step).
 """
 
+import os
+
 import pytest
 
 from lightgbm_tpu.analysis.baseline import (BaselineError, apply_baseline,
                                             parse_baseline)
 from lightgbm_tpu.analysis.cli import main as lint_main
 from lightgbm_tpu.analysis.engine import run_lint
+from lightgbm_tpu.analysis.program import Program, fault_site_findings
 from lightgbm_tpu.analysis.rules import analyze_source
 
 
@@ -254,6 +257,326 @@ def entry(x):
 
 
 # ---------------------------------------------------------------------------
+# r16: GL008-GL011, one seeded violation + negative twin per rule
+# ---------------------------------------------------------------------------
+
+GL008_BAD = """\
+import time
+import random
+import numpy as np
+from datetime import datetime
+
+def tick():
+    t0 = time.perf_counter()
+    time.sleep(0.1)
+    stamp = datetime.now()
+    jitter = random.random()
+    rng = np.random.default_rng()
+    legacy = np.random.rand(3)
+    return t0, stamp, jitter, rng, legacy
+"""
+
+GL008_GOOD = """\
+import time
+import numpy as np
+
+def tick(clock=time.monotonic, rng=None):
+    rng = np.random.default_rng(1234) if rng is None else rng
+    return clock(), rng.uniform()
+"""
+
+
+def test_gl008_direct_wall_clock_and_global_rng():
+    lines = rules_at(GL008_BAD, "GL008")
+    assert lines == [line_of(GL008_BAD, "perf_counter"),
+                     line_of(GL008_BAD, "time.sleep"),
+                     line_of(GL008_BAD, "datetime.now"),
+                     line_of(GL008_BAD, "random.random"),
+                     line_of(GL008_BAD, "default_rng()"),
+                     line_of(GL008_BAD, "np.random.rand")]
+
+
+def test_gl008_injected_clock_and_seeded_rng_are_clean():
+    # `clock=time.monotonic` is a bare REFERENCE (the sanctioned
+    # injection idiom), `clock()` resolves to a parameter, and the
+    # default_rng has an explicit seed — nothing fires
+    assert rules_at(GL008_GOOD, "GL008") == []
+
+
+def test_gl008_from_import_form():
+    src = ("from time import perf_counter\n\n"
+           "def t():\n    return perf_counter()\n")
+    assert rules_at(src, "GL008") == [4]
+
+
+def test_gl008_inline_waiver():
+    src = GL008_BAD.replace(
+        "time.sleep(0.1)",
+        "time.sleep(0.1)  # graftlint: GL008 — operator backoff")
+    assert line_of(src, "time.sleep") not in rules_at(src, "GL008")
+
+
+GL009_BAD = """\
+import threading
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.events = []
+
+    def bump(self):
+        with self._lock:
+            self.hits += 1
+            self.events.append("hit")
+
+    def racy_reset(self):
+        self.hits *= 0
+        self.events.clear()
+"""
+
+GL009_GOOD = GL009_BAD.replace(
+    "threading.Lock()", "threading.RLock()").replace(
+    """    def racy_reset(self):
+        self.hits *= 0
+        self.events.clear()""",
+    """    def racy_reset(self):
+        with self._lock:
+            self.hits *= 0
+            self.events.clear()""")
+
+
+def test_gl009_mixed_locked_unlocked_mutation():
+    # both attrs are written under the lock in bump() and without it in
+    # racy_reset() -> the rule flags the UNLOCKED sites
+    assert rules_at(GL009_BAD, "GL009") == [
+        line_of(GL009_BAD, "self.hits *= 0"),
+        line_of(GL009_BAD, "self.events.clear()")]
+
+
+def test_gl009_lock_correct_twin_is_silent():
+    assert rules_at(GL009_GOOD, "GL009") == []
+
+
+def test_gl009_init_and_lockless_classes_exempt():
+    # __init__ writes precede sharing and never count as unlocked; a
+    # class with no lock attribute is out of scope entirely
+    lockless = GL009_BAD.replace(
+        "        self._lock = threading.Lock()\n", "").replace(
+        "        with self._lock:\n            self.hits += 1\n"
+        "            self.events.append(\"hit\")",
+        "        self.hits += 1\n        self.events.append(\"hit\")")
+    assert rules_at(lockless, "GL009") == []
+
+
+GL011_BAD = """\
+def load(path):
+    try:
+        return open(path).read()
+    except:
+        return None
+
+def push(x):
+    try:
+        x.send()
+    except ValueError:
+        pass
+
+def fail():
+    raise Exception("boom")
+"""
+
+GL011_GOOD = """\
+class PushError(RuntimeError):
+    pass
+
+def load(path):
+    try:
+        return open(path).read()
+    except OSError:
+        return None
+
+def push(x, log):
+    try:
+        x.send()
+    except ValueError as e:
+        log.append(e)
+
+def fail():
+    raise PushError("boom")
+"""
+
+
+def test_gl011_bare_swallowed_and_untyped():
+    assert rules_at(GL011_BAD, "GL011") == [
+        line_of(GL011_BAD, "except:"),
+        line_of(GL011_BAD, "except ValueError"),
+        line_of(GL011_BAD, "raise Exception")]
+
+
+def test_gl011_typed_twin_is_silent():
+    assert rules_at(GL011_GOOD, "GL011") == []
+
+
+def test_gl011_inline_waiver_on_except_line():
+    src = GL011_BAD.replace(
+        "    except ValueError:",
+        "    except ValueError:  # graftlint: GL011 — best-effort push")
+    assert line_of(src, "except ValueError") not in rules_at(src, "GL011")
+
+
+# ---------------------------------------------------------------------------
+# r16 tentpole: whole-program analysis (cross-module closure, GL010)
+# ---------------------------------------------------------------------------
+
+def prog_findings(modules, rule=None):
+    fs = Program(modules).run_rules()
+    return [f for f in fs if rule is None or f.rule == rule]
+
+
+def test_cross_module_traced_closure():
+    # work() lives in another FILE and is traced only because a jitted
+    # entry point imports and calls it — per-file analysis cannot see
+    # this; the Program closure must
+    entry = ("import jax\nfrom pkg.helper import work\n\n@jax.jit\n"
+             "def run(x):\n    return work(x)\n")
+    helper = "def work(x):\n    return x.item()\n"
+    fs = prog_findings([("pkg/entry.py", entry),
+                        ("pkg/helper.py", helper)], "GL002")
+    assert [(f.path, f.line) for f in fs] == [("pkg/helper.py", 2)]
+    # the same helper with no traced caller stays clean
+    assert prog_findings([("pkg/helper.py", helper)], "GL002") == []
+
+
+def test_cross_module_closure_through_module_alias():
+    # dotted call form: entry imports the MODULE and calls pkg.work(...)
+    entry = ("import jax\nfrom pkg import helper\n\n@jax.jit\n"
+             "def run(x):\n    return helper.work(x)\n")
+    helper = "def work(x):\n    return x.item()\n"
+    fs = prog_findings([("pkg/entry.py", entry),
+                        ("pkg/helper.py", helper)], "GL002")
+    assert [(f.path, f.line) for f in fs] == [("pkg/helper.py", 2)]
+
+
+def test_cross_module_closure_relative_import():
+    entry = ("import jax\nfrom .helper import work\n\n@jax.jit\n"
+             "def run(x):\n    return work(x)\n")
+    helper = "def work(x):\n    return x.item()\n"
+    fs = prog_findings([("pkg/entry.py", entry),
+                        ("pkg/helper.py", helper)], "GL002")
+    assert [(f.path, f.line) for f in fs] == [("pkg/helper.py", 2)]
+
+
+_GL010_FAULTS = """\
+SERVING_SITES = ("predict", "flip")
+TRAINING_SITES = ()
+PIPELINE_SITES = ()
+SITES = SERVING_SITES + TRAINING_SITES + PIPELINE_SITES
+"""
+
+_GL010_USE = """\
+class Runtime:
+    def __init__(self, faults):
+        self.faults = faults
+
+    def predict(self):
+        self.faults.check("predict")
+        self.faults.check("mistyped")
+"""
+
+
+def test_gl010_all_three_drift_directions():
+    prog = Program([("pkg/faults.py", _GL010_FAULTS),
+                    ("pkg/runtime.py", _GL010_USE)])
+    fs = fault_site_findings(prog, [("tests/test_x.py",
+                                     "SITE = 'predict'\n")])
+    msgs = {(f.path, f.message.split("'")[1]) for f in fs}
+    # direction 1: consulted site missing from the registry
+    assert ("pkg/runtime.py", "mistyped") in msgs
+    # direction 2: registered site never consulted
+    assert ("pkg/faults.py", "flip") in msgs
+    # direction 3: registered site absent from the chaos tests
+    assert sum(1 for p, s in msgs if s == "flip") == 1  # unused+untested
+    untested = [f for f in fs if "not referenced by any" in f.message]
+    assert {f.message.split("'")[1] for f in untested} == {"flip"}
+    assert all(f.rule == "GL010" for f in fs)
+
+
+def test_gl010_drift_free_twin_is_silent():
+    use = _GL010_USE.replace('self.faults.check("mistyped")',
+                             'self.faults.check("flip")')
+    prog = Program([("pkg/faults.py", _GL010_FAULTS),
+                    ("pkg/runtime.py", use)])
+    tests = [("tests/test_x.py", "COVERED = ('predict', 'flip')\n")]
+    assert fault_site_findings(prog, tests) == []
+
+
+def test_gl010_arm_and_faultspec_count_as_consultation():
+    use = ("from pkg.faults import FaultSpec\n\n"
+           "def chaos(inj):\n"
+           "    inj.arm('predict')\n"
+           "    return FaultSpec(site='flip')\n")
+    prog = Program([("pkg/faults.py", _GL010_FAULTS),
+                    ("pkg/chaos.py", use)])
+    fs = fault_site_findings(prog, ())     # no tests -> coverage skipped
+    assert fs == []
+
+
+def test_gl010_noninjectorish_check_is_ignored():
+    # .check() on something that is not a fault injector must not count
+    # as consultation (precision guard) — "predict"/"flip" stay unused
+    use = "def f(validator):\n    validator.check('predict')\n"
+    prog = Program([("pkg/faults.py", _GL010_FAULTS),
+                    ("pkg/other.py", use)])
+    fs = fault_site_findings(prog, ())
+    assert {f.message.split("'")[1] for f in fs} == {"predict", "flip"}
+
+
+@pytest.mark.lint
+def test_real_registry_has_no_drift_and_pipeline_sites_covered():
+    """The repo's own faults.SITES registry: every site consulted, every
+    site chaos-tested — including all four r15 PIPELINE_SITES."""
+    from lightgbm_tpu import faults
+    from lightgbm_tpu.analysis.engine import (PACKAGE_ROOT, REPO_ROOT,
+                                              _read_sources)
+
+    prog = Program(_read_sources([PACKAGE_ROOT]))
+    tests = _read_sources([os.path.join(REPO_ROOT, "tests")])
+    assert fault_site_findings(prog, tests) == []
+    assert set(faults.PIPELINE_SITES) == {
+        "data_arrival", "continue_train", "artifact_push", "flip"}
+    # and the drift check is not vacuous: drop the test tree and the
+    # coverage direction must be able to fire
+    assert len(faults.SITES) == 12
+
+
+# ---------------------------------------------------------------------------
+# r16: Layer-2 budget anchors (specs must reference live symbols)
+# ---------------------------------------------------------------------------
+
+def test_budget_anchors_all_live():
+    from lightgbm_tpu.analysis.budgets import check_budget_anchors
+
+    res = check_budget_anchors()
+    assert len(res) >= 15
+    assert all(r["ok"] for r in res), [r for r in res if not r["ok"]]
+
+
+def test_budget_anchor_detects_renamed_symbol_and_dead_file():
+    from lightgbm_tpu.analysis.budgets import check_budget_anchors
+
+    res = check_budget_anchors({
+        "launch": (("lightgbm_tpu/models/tree.py", "grow_tree"),
+                   ("lightgbm_tpu/models/tree.py", "grow_tree_v2"),
+                   ("lightgbm_tpu/models/gone.py", "grow_tree"))})
+    by = {(r["path"], r["symbol"]): r for r in res}
+    assert by[("lightgbm_tpu/models/tree.py", "grow_tree")]["ok"]
+    stale = by[("lightgbm_tpu/models/tree.py", "grow_tree_v2")]
+    assert not stale["ok"] and "grow_tree_v2" in stale["why"]
+    assert not by[("lightgbm_tpu/models/gone.py", "grow_tree")]["ok"]
+
+
+# ---------------------------------------------------------------------------
 # baseline machinery
 # ---------------------------------------------------------------------------
 
@@ -327,13 +650,73 @@ def test_cli_exit_codes(tmp_path, capsys):
 @pytest.mark.parametrize("snippet,rule", [
     (GL001_BAD, "GL001"), (GL002_BAD, "GL002"), (GL003_BAD, "GL003"),
     (GL004_BAD, "GL004"), (GL005_BAD, "GL005"), (GL006_BAD, "GL006"),
-    (GL007_BAD, "GL007"),
-], ids=["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007"])
+    (GL007_BAD, "GL007"), (GL008_BAD, "GL008"), (GL009_BAD, "GL009"),
+    (GL011_BAD, "GL011"),
+], ids=["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
+        "GL008", "GL009", "GL011"])
 def test_cli_nonzero_per_seeded_rule(tmp_path, snippet, rule, capsys):
     p = tmp_path / f"{rule.lower()}.py"
     p.write_text(snippet)
     assert lint_main([str(p), "--no-vmem", "-q"]) == 1
     assert rule in capsys.readouterr().out
+
+
+@pytest.mark.lint
+def test_cli_format_github_annotations(tmp_path, capsys):
+    p = tmp_path / "seeded.py"
+    p.write_text(GL001_BAD)
+    assert lint_main([str(p), "--no-vmem", "--format", "github"]) == 1
+    out = capsys.readouterr().out
+    first = out.splitlines()[0]
+    assert first.startswith(f"::error file={p},line=6,col=")
+    assert "title=graftlint GL001::" in first
+    # clean tree -> no annotation lines at all
+    g = tmp_path / "clean.py"
+    g.write_text(GL001_GOOD)
+    assert lint_main([str(g), "--no-vmem", "--no-baseline",
+                      "--format", "github"]) == 0
+    assert "::error" not in capsys.readouterr().out
+
+
+@pytest.mark.lint
+def test_cli_exit_2_usage_error(tmp_path, capsys):
+    p = tmp_path / "x.py"
+    p.write_text("x = 1\n")
+    b = tmp_path / "bad.toml"
+    b.write_text("[suppress]\n")            # not the array-table form
+    assert lint_main([str(p), "--baseline", str(b),
+                      "--no-vmem", "-q"]) == 2
+    assert "graftlint: usage-error:" in capsys.readouterr().err
+
+
+@pytest.mark.lint
+def test_cli_exit_3_internal_error(tmp_path, capsys):
+    # a directory where the baseline file should be -> IsADirectoryError
+    # inside the analyzer; the CLI must report a typed one-liner and
+    # exit 3, NOT pretend the tree has findings
+    p = tmp_path / "x.py"
+    p.write_text("x = 1\n")
+    d = tmp_path / "bldir"
+    d.mkdir()
+    assert lint_main([str(p), "--baseline", str(d),
+                      "--no-vmem", "-q"]) == 3
+    err = capsys.readouterr().err
+    assert err.startswith("graftlint: internal-error: IsADirectoryError")
+    assert "Traceback" not in err
+
+
+@pytest.mark.lint
+def test_gl000_parse_failure_bypasses_baseline_and_waivers(tmp_path,
+                                                           capsys):
+    bad = tmp_path / "broken.py"
+    # the waiver comment is unreachable: the file does not parse
+    bad.write_text("def f(:  # graftlint: GL000 — nope\n")
+    b = tmp_path / "b.toml"
+    b.write_text(f'[[suppress]]\nrule = "GL000"\npath = "{bad}"\n'
+                 f'count = 5\nreason = "trying to baseline a parse "\n')
+    assert lint_main([str(bad), "--baseline", str(b),
+                      "--no-vmem", "-q"]) == 1
+    assert "GL000" in capsys.readouterr().out
 
 
 def test_vmem_specs_fit_budget():

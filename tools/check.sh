@@ -1,9 +1,12 @@
 #!/usr/bin/env bash
-# One command for the whole gate: style -> graftlint -> budget specs.
+# One command for the whole gate: style -> lint-v2 -> parity/chaos lanes.
 #
-#   tools/check.sh          # style (if ruff present) + lint + vmem
-#   tools/check.sh --full   # also HLO launch budgets + recompile sweeps
-#                           # (lowers real entry points; ~minutes on CPU)
+#   tools/check.sh          # everything, including launch budgets +
+#                           # recompile sweeps (~minutes on CPU)
+#
+# The r16 lint-v2 lane runs the whole-program graftlint pass AND the
+# trace-level budgets unconditionally; `--full` is kept as a no-op so
+# existing invocations don't break.
 #
 # Exit: nonzero on the first failing layer.  Tier-1 already runs the
 # same checks through the pytest bridge (`-m lint`); this script is the
@@ -11,10 +14,9 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-full=0
 for a in "$@"; do
   case "$a" in
-    --full) full=1 ;;
+    --full) ;;  # r16: budgets always run in the lint-v2 lane now
     *) echo "usage: tools/check.sh [--full]" >&2; exit 2 ;;
   esac
 done
@@ -28,12 +30,21 @@ else
   echo "== ruff == (not installed; skipping style layer)"
 fi
 
-# 2. graftlint: AST rules + baseline + VMEM estimates + comm byte AND
-#    comm TIME budgets (r10: the pipelined merge must keep >=60% of the
-#    ring hidden; r11 adds the PCIe stream-prefetch budget at the same
-#    60% floor — the host->HBM transfer must hide behind hist compute)
-echo "== graftlint =="
+# 2. lint-v2: the whole-program graftlint pass — cross-module traced
+#    closure, determinism (GL008), lock discipline (GL009), fault-site
+#    registry drift (GL010), typed-error discipline (GL011), budget
+#    anchors — plus the VMEM estimates and the arithmetic budget models
+#    (comm bytes/time, stream, serve SLO, ckpt, freshness).  GL000
+#    parse failures bypass the baseline AND waivers, so an unparseable
+#    file fails this lane hard; exit 3 means the analyzer itself broke.
+echo "== lint-v2 (whole-program graftlint) =="
 JAX_PLATFORMS=cpu python -m lightgbm_tpu lint
+
+#    ...plus the trace-level budgets: HLO launch counts + zero-recompile
+#    sweeps (lowers real entry points; ~a minute on CPU)
+echo "== lint-v2: launch budgets + recompile sweeps =="
+JAX_PLATFORMS=cpu python -m lightgbm_tpu lint --budgets -q
+echo "budget specs ok"
 
 # 3. merge-mode serial parity on the virtual 8-device mesh (fast
 #    subset — the same scenarios tier-1 sees in tests/test_merge_modes.py;
@@ -93,10 +104,3 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py \
 echo "== freshness (refresh pipeline + staleness SLO) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_freshness.py -q \
   -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
-
-# 9. trace-level budgets (slow lane)
-if [ "$full" = 1 ]; then
-  echo "== budgets + recompile sweeps =="
-  JAX_PLATFORMS=cpu python -m lightgbm_tpu lint --budgets -q
-  echo "budget specs ok"
-fi
