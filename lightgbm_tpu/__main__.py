@@ -82,7 +82,31 @@ Keys (validated up front; unknown keys are rejected like ``serve``):
 ``model_name`` (default "model"), ``max_ticks`` (default 64 — the CLI
 drains the watch directory and exits; schedulers rerun it).  Remaining
 keys are LightGBM training params, checked against the known parameter
-vocabulary.
+vocabulary.  r17 adds the closed tune->serve loop: ``sweep_grid=<json>``
++ ``sweep_every=N`` makes every Nth data-bearing generation sweep the
+grid first and promote the winning config through the same
+canary->flip path (``sweep_rounds``/``sweep_nfold``/
+``sweep_early_stopping``/``sweep_devices`` bound the sweep).
+
+``task=sweep`` (r17) runs a standalone distributed sweep over a
+CSV/TSV training file: the grid (JSON — ``{"axes": {...}}`` expands the
+cartesian product, ``{"rows": [...]}`` or a bare list is explicit)
+shards into fused-CV hyper-batches over a configs x devices mesh, every
+hyper-batch checkpoints between segments, and the ledger is crash-safe
+and resumable — a preempted sweep exits 0 and the SAME command line
+resumes bit-identically:
+
+    python -m lightgbm_tpu task=sweep data=train.csv \
+        sweep_grid=grid.json ledger=sweep.json \
+        sweep_checkpoint_dir=ck/ sweep_devices=8 num_trees=500
+
+Keys (typed validation, unknown keys rejected): ``sweep_grid``
+(required), ``ledger`` (path; ``.RData`` suffix selects the reference's
+codec), ``sweep_checkpoint_dir``, ``sweep_devices``/
+``sweep_group_size`` (mesh shape), ``nfold`` (default 5),
+``early_stopping_rounds`` (5), ``hyper_batch`` (36),
+``engine=auto|fused|host``, ``seed``, ``top`` (leaderboard rows
+printed, default 10).  Remaining keys are the shared base params.
 """
 
 from __future__ import annotations
@@ -165,7 +189,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         # usage error, never a traceback
         raise SystemExit(
             f"lightgbm_tpu: {e}\nusage: python -m lightgbm_tpu "
-            "task=train|predict|serve|refresh key=value ... "
+            "task=train|predict|serve|refresh|sweep key=value ... "
             "(or config=<file>; see module docs)") from None
     task = cfg.pop("task", "train")
     header = cfg.pop("header", "false").lower() in ("true", "1", "yes")
@@ -246,8 +270,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _serve(input_model, cfg)
     if task == "refresh":
         return _refresh(cfg)
+    if task == "sweep":
+        return _sweep(cfg, data_path, header, label_spec)
     raise SystemExit(
-        f"unknown task {task!r} (train|predict|serve|refresh)")
+        f"unknown task {task!r} (train|predict|serve|refresh|sweep)")
 
 
 def _parse_request_line(line: str) -> Optional[np.ndarray]:
@@ -517,6 +543,19 @@ def _refresh(cfg: Dict[str, str], stdout=None, stderr=None) -> int:
     canary_rows = intkey("canary_rows", "8", 0)
     max_ticks = intkey("max_ticks", "64", 1)
     model_name = cfg.pop("model_name", "model")
+    # r17 closed tune->serve loop: every sweep_every'th data-bearing
+    # generation sweeps the grid and promotes the winner
+    grid_path = cfg.pop("sweep_grid", None)
+    sweep_grid = None
+    if grid_path is not None:
+        sweep_grid = _load_grid(grid_path, die)
+    sweep_every = intkey("sweep_every", "0", 0)
+    if sweep_every > 0 and sweep_grid is None:
+        raise die("sweep_every > 0 requires sweep_grid=<grid.json>")
+    sweep_rounds = intkey("sweep_rounds", "50", 1)
+    sweep_nfold = intkey("sweep_nfold", "3", 2)
+    sweep_early_stopping = intkey("sweep_early_stopping", "5", 0)
+    sweep_devices = intkey("sweep_devices", "1", 1)
     slo_s = cfg.pop("staleness_slo_ms", None)
     staleness_slo_ms = None
     if slo_s is not None:
@@ -539,7 +578,11 @@ def _refresh(cfg: Dict[str, str], stdout=None, stderr=None) -> int:
         model_name=model_name, refresh_rounds=refresh_rounds,
         initial_rounds=initial_rounds,
         checkpoint_rounds=checkpoint_rounds,
-        staleness_slo_ms=staleness_slo_ms, canary_rows=canary_rows)
+        staleness_slo_ms=staleness_slo_ms, canary_rows=canary_rows,
+        sweep_grid=sweep_grid, sweep_every=sweep_every,
+        sweep_rounds=sweep_rounds, sweep_nfold=sweep_nfold,
+        sweep_early_stopping=sweep_early_stopping,
+        sweep_devices=sweep_devices)
     events = daemon.run_until_idle(max_ticks=max_ticks)
     for ev in events:
         doc = {k: v for k, v in ev.items() if k != "report"}
@@ -550,6 +593,139 @@ def _refresh(cfg: Dict[str, str], stdout=None, stderr=None) -> int:
         "served": snap["served"],
         "worst_staleness_ms": snap["worst_staleness_ms"],
         "breaches": snap["breaches"],
+    }) + "\n")
+    stdout.flush()
+    stderr.flush()
+    return 0
+
+
+def _load_grid(path: str, die) -> list:
+    """Load a sweep grid from a JSON file: ``{"axes": {...}}`` expands
+    the cartesian product (R ``expand.grid`` order), ``{"rows": [...]}``
+    or a bare list of objects is the explicit row set.  Every misuse is
+    a typed one-line error through ``die``."""
+    import json
+
+    from .sweep import expand_grid
+
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except OSError as e:
+        raise die(f"sweep_grid file unreadable: {e}") from None
+    except json.JSONDecodeError as e:
+        raise die(f"sweep_grid is not valid JSON: {e}") from None
+    if isinstance(doc, dict) and "axes" in doc:
+        axes = doc["axes"]
+        if not isinstance(axes, dict) or not axes or \
+                not all(isinstance(v, list) and v for v in axes.values()):
+            raise die('sweep_grid "axes" must map param names to '
+                      "non-empty lists of values")
+        return expand_grid(**axes)
+    rows = doc.get("rows") if isinstance(doc, dict) else doc
+    if not isinstance(rows, list) or not rows or \
+            not all(isinstance(r, dict) for r in rows):
+        raise die('sweep_grid must be {"axes": {...}}, {"rows": [...]}, '
+                  "or a JSON list of config objects")
+    return [dict(r) for r in rows]
+
+
+def _sweep(cfg: Dict[str, str], data_path: Optional[str], header: bool,
+           label_spec: str, stdout=None, stderr=None) -> int:
+    """``task=sweep``: run (or resume) a standalone hyperparameter sweep
+    over a CSV/TSV training file through the r17 ``SweepService`` —
+    scheduled hyper-batches on the fused-CV engine, per-hyper-batch
+    checkpoints, a crash-safe resumable ledger, and a leaderboard on
+    stdout.  Validation follows the ``serve``/``refresh`` contract:
+    every sweep key is checked up front with typed one-line errors,
+    unknown keys are rejected against the parameter vocabulary, and a
+    preemption exits 0 with the resume instruction — schedulers just
+    rerun the same command line."""
+    import json
+
+    from .config import _ALIASES, _FRAMEWORK_KEYS
+    from .engine import _resolve_num_rounds
+
+    stdout = sys.stdout if stdout is None else stdout
+    stderr = sys.stderr if stderr is None else stderr
+
+    def die(msg: str) -> "SystemExit":
+        return SystemExit(f"task=sweep: {msg}")
+
+    def intkey(key: str, default, minimum: int):
+        raw_v = cfg.pop(key, default)
+        if raw_v is None:
+            return None
+        try:
+            v = int(raw_v)
+        except ValueError:
+            raise die(f"{key} must be an integer, got {raw_v!r}") \
+                from None
+        if v < minimum:
+            raise die(f"{key} must be >= {minimum}, got {v}")
+        return v
+
+    if data_path is None:
+        raise die("requires data=<train file>")
+    grid_path = cfg.pop("sweep_grid", None)
+    if not grid_path:
+        raise die('requires sweep_grid=<grid.json> ({"axes": {...}}, '
+                  '{"rows": [...]}, or a list of config objects)')
+    grid = _load_grid(grid_path, die)
+    sweep_devices = intkey("sweep_devices", "1", 1)
+    sweep_group_size = intkey("sweep_group_size", "1", 1)
+    if sweep_devices % sweep_group_size:
+        raise die(f"sweep_group_size must divide sweep_devices (got "
+                  f"group_size={sweep_group_size}, "
+                  f"devices={sweep_devices})")
+    ckpt_dir = cfg.pop("sweep_checkpoint_dir", None)
+    if ckpt_dir is not None and not str(ckpt_dir).strip():
+        raise die("sweep_checkpoint_dir must be a directory path")
+    ledger_path = cfg.pop("ledger", None)
+    nfold = intkey("nfold", "5", 2)
+    early_stopping = intkey("early_stopping_rounds", "5", 0)
+    hyper_batch = intkey("hyper_batch", "36", 1)
+    seed = intkey("seed", "0", 0)
+    top = intkey("top", "10", 1)
+    engine = cfg.pop("engine", "auto")
+    if engine not in ("auto", "fused", "host"):
+        raise die(f"engine must be auto|fused|host, got {engine!r}")
+    unknown = sorted(k for k in cfg
+                     if k.lower() not in _ALIASES
+                     and k.lower() not in _FRAMEWORK_KEYS)
+    if unknown:
+        raise die(f"unknown key(s): {', '.join(unknown)}")
+    params = dict(cfg)
+    rounds = _resolve_num_rounds(params, 100)
+
+    import lightgbm_tpu as lgb
+
+    from .sweep import SweepService
+
+    data, names = _load_table(data_path, header)
+    X, y = _split_label(data, names, label_spec)
+    service = SweepService(
+        grid, lgb.Dataset(X, label=y), base_params=params,
+        num_boost_round=rounds, nfold=nfold,
+        early_stopping_rounds=early_stopping, seed=seed, engine=engine,
+        ledger_path=ledger_path, checkpoint_dir=ckpt_dir,
+        n_devices=sweep_devices, group_size=sweep_group_size,
+        hyper_batch=hyper_batch, verbose=True)
+    result = service.run()
+    if result.preempted:
+        pend = len(result.ledger.pending())
+        stderr.write(f"[lightgbm_tpu] sweep preempted ({result.error}); "
+                     f"{pend}/{len(grid)} configs pending — rerun the "
+                     f"same command line to resume\n")
+        stderr.flush()
+        return 0
+    for row in result.ledger.leaderboard()[:top]:
+        stdout.write(json.dumps(row) + "\n")
+    stderr.write(json.dumps({
+        "engine": result.engine, "units": result.units_total,
+        "resumed_units": result.resumed_units,
+        "configs": len(grid),
+        "rounds_total": result.stats.get("rounds_total", 0),
     }) + "\n")
     stdout.flush()
     stderr.flush()

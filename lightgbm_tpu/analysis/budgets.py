@@ -1419,6 +1419,221 @@ def check_freshness_budgets(names: Optional[List[str]] = None
 
 
 # ---------------------------------------------------------------------------
+# sweep throughput + tune->serve staleness budgets (ISSUE r17)
+# ---------------------------------------------------------------------------
+# Sweep-as-a-service (lightgbm_tpu.sweep) prices hyperparameter search
+# in configs/hour: the scheduler packs the grid into fused-CV
+# hyper-batches and spreads them over a configs x devices mesh, so the
+# serial reference loop's cost model gains two levers — batching (one
+# XLA program amortizes B = configs x folds trainings) and the mesh
+# (device groups run hyper-batches concurrently; the makespan is the
+# slowest group's bucket chain, the scheduler's greedy-LPT quantity).
+#
+# The REFERENCE SHAPE is the paper's own sweep: 108 configs x 5-fold CV
+# on the 46k-row claims table, ~150 boosting rounds to early-stop, 9
+# fused buckets of 12 configs (the (num_leaves, lr, bagging) statics of
+# the reference grid).  Legs are charged from the SAME measured
+# constants the other budget families use (TRAIN_ROWS_PER_S per round,
+# HOST_WRITE/CKPT for the ledger) plus three sweep-specific ones
+# calibrated against tools/bench_sweep.py on the dryrun mesh: the
+# per-bucket compile, the batched-execution efficiency (B elements cost
+# B/FUSED_BATCH_EFF serial-equivalents — histogram work vectorizes, the
+# while_loop does not), and the straggler factor (a bucket runs until
+# its SLOWEST config early-stops).
+#
+# The tune->serve staleness line extends the r15 freshness model: a
+# RETUNE generation's data-arrival -> serving time is the sweep
+# makespan plus the winner's cold train plus the unchanged
+# publish/warm/canary/flip legs — bounded by TUNE_SERVE_SLO_S at D=8,
+# while the guard entry proves the serial ledger loop CANNOT meet it
+# (cmp="ge"): the mesh is load-bearing for closed-loop tuning, not an
+# optimization.
+# ---------------------------------------------------------------------------
+
+SWEEP_COMPILE_S_PER_BUCKET = 12.0   # one fused batch program (measured r7)
+HOST_ROUND_LATENCY_S = 1.5e-3       # serial loop's per-round host overhead
+FUSED_BATCH_EFF = 3.0               # B batch elements ~ B/3 serial cost
+SWEEP_STRAGGLER = 1.3               # bucket runs to its slowest config
+GROUP_OVERLAP_EFF = 0.75            # multi-device group scaling efficiency
+LEDGER_SAVE_S = 5e-3                # atomic tmp+fsync+rename per commit
+TUNE_SERVE_SLO_S = 300.0            # retune data-arrival -> serving bound
+
+
+def sweep_time_model(n_configs: int = 108, n_rows: int = 46_000,
+                     nfold: int = 5, rounds_mean: int = 150,
+                     n_buckets: int = 9, n_devices: int = 1,
+                     group_size: int = 1) -> Dict[str, float]:
+    """Closed-form sweep cost at one operating point.
+
+    ``serial_s`` prices the reference's per-config host loop (every
+    fold x round pays the full row pass plus host dispatch latency,
+    plus one ledger commit per config).  ``makespan_s`` prices the
+    scheduled fused sweep: each bucket pays one compile plus its
+    batched execution (straggler-inflated), buckets spread greedily
+    over ``n_devices // group_size`` groups, and the makespan is the
+    slowest group's chain — ceil(n_buckets / n_groups) buckets when
+    buckets are near-uniform, as at the reference shape.
+    """
+    round_s = int(n_rows) / TRAIN_ROWS_PER_S
+    serial_s = (int(n_configs) * int(nfold) * int(rounds_mean)
+                * (round_s + HOST_ROUND_LATENCY_S)
+                + int(n_configs) * LEDGER_SAVE_S)
+
+    cfg_per_bucket = int(n_configs) / max(int(n_buckets), 1)
+    batch = cfg_per_bucket * int(nfold)
+    exec_eff = FUSED_BATCH_EFF * (
+        1.0 if group_size <= 1 else int(group_size) * GROUP_OVERLAP_EFF)
+    bucket_s = (SWEEP_COMPILE_S_PER_BUCKET
+                + int(rounds_mean) * round_s * batch / exec_eff
+                * SWEEP_STRAGGLER)
+    n_groups = max(int(n_devices) // max(int(group_size), 1), 1)
+    chain = -(-int(n_buckets) // n_groups)   # ceil: slowest group's load
+    makespan_s = chain * bucket_s + int(n_buckets) * LEDGER_SAVE_S
+    return {
+        "round_s": round_s,
+        "serial_s": serial_s,
+        "configs_per_hour_serial": int(n_configs) / serial_s * 3600.0,
+        "bucket_s": bucket_s,
+        "n_groups": float(n_groups),
+        "chain_buckets": float(chain),
+        "makespan_s": makespan_s,
+        "configs_per_hour": int(n_configs) / makespan_s * 3600.0,
+        "speedup": serial_s / makespan_s,
+    }
+
+
+def sweep_staleness_model(n_configs: int = 108, n_rows: int = 46_000,
+                          nfold: int = 5, rounds_mean: int = 150,
+                          n_buckets: int = 9, n_devices: int = 8,
+                          group_size: int = 1, num_leaves: int = 127,
+                          warm_shapes: int = 4, canary_rows: int = 8,
+                          serial: bool = False) -> Dict[str, float]:
+    """Tune->serve staleness for a retune generation: the sweep (fused
+    mesh, or the serial ledger loop when ``serial=True``) + the
+    winner's cold train to its best iteration + the r15 freshness
+    legs (publish, warm, canary, flip) charged from the same
+    constants ``staleness_model`` uses."""
+    t = sweep_time_model(n_configs, n_rows, nfold, rounds_mean,
+                         n_buckets, n_devices, group_size)
+    sweep_s = t["serial_s"] if serial else t["makespan_s"]
+    round_s = t["round_s"]
+    train_s = int(rounds_mean) * round_s
+    nodes = 2 * int(num_leaves) - 1
+    node_bytes = 7 * 4 + 1
+    artifact_bytes = int(rounds_mean) * nodes * node_bytes + 4096
+    publish_s = artifact_bytes / HOST_WRITE_BYTES_PER_S \
+        + CKPT_FIXED_LATENCY_S
+    warm_s = int(warm_shapes) * WARM_COMPILE_S_PER_SHAPE
+    canary_s = (2 * SERVE_DISPATCH_FIXED_S
+                + int(canary_rows) * int(rounds_mean)
+                * CANARY_ORACLE_S_PER_ROW_TREE)
+    tune_serve_s = sweep_s + train_s + publish_s + warm_s + canary_s \
+        + FLIP_S
+    return {
+        "sweep_s": sweep_s,
+        "winner_train_s": train_s,
+        "publish_s": publish_s,
+        "warm_s": warm_s,
+        "canary_s": canary_s,
+        "flip_s": FLIP_S,
+        "tune_serve_s": tune_serve_s,
+        "sweep_frac": sweep_s / tune_serve_s,
+    }
+
+
+@dataclass(frozen=True)
+class SweepBudget:
+    """One sweep-throughput / tune->serve invariant.
+
+    ``model`` selects the closed form ("time" ->
+    :func:`sweep_time_model`, "staleness" ->
+    :func:`sweep_staleness_model`); ``metric`` the compared output.
+    ``cmp`` is "le" for acceptance bars and "ge" for guard-the-model
+    entries (operating points MEANT to breach)."""
+
+    name: str
+    budget: float
+    metric: str
+    cmp: str = "ge"
+    model: str = "time"
+    n_configs: int = 108
+    n_rows: int = 46_000
+    nfold: int = 5
+    rounds_mean: int = 150
+    n_buckets: int = 9
+    n_devices: int = 1
+    group_size: int = 1
+    serial: bool = False
+    note: str = ""
+
+    def check(self) -> Dict[str, object]:
+        if self.model == "time":
+            t = sweep_time_model(
+                self.n_configs, self.n_rows, self.nfold,
+                self.rounds_mean, self.n_buckets, self.n_devices,
+                self.group_size)
+        else:
+            t = sweep_staleness_model(
+                self.n_configs, self.n_rows, self.nfold,
+                self.rounds_mean, self.n_buckets, self.n_devices,
+                self.group_size, serial=self.serial)
+        measured = t[self.metric]
+        ok = (measured <= self.budget if self.cmp == "le"
+              else measured >= self.budget)
+        return {"name": self.name, "mode": "sweep",
+                "metric": self.metric, "measured": round(measured, 4),
+                "budget": self.budget, "cmp": self.cmp,
+                "n_devices": self.n_devices, "ok": ok,
+                "note": self.note}
+
+
+SWEEP_BUDGETS: Tuple[SweepBudget, ...] = (
+    SweepBudget("sweep_speedup_d8", 2.0, "speedup", n_devices=8,
+                note="r17 acceptance: the 8-device mesh sweeps the "
+                     "reference grid >= 2x faster than the serial "
+                     "ledger loop (model says ~8.7x: batching x "
+                     "mesh, compile amortized per bucket)"),
+    SweepBudget("sweep_fused_gain_d1", 1.5, "speedup", n_devices=1,
+                note="the fused hyper-batch alone (one device, no "
+                     "mesh) beats the serial loop >= 1.5x — batching "
+                     "is a win before any scale-out"),
+    SweepBudget("sweep_configs_per_hour_d8", 3000.0,
+                "configs_per_hour", n_devices=8,
+                note="throughput floor the bench reports against: "
+                     ">= 3000 configs/hour at D=8 on the reference "
+                     "shape (serial manages ~600)"),
+    SweepBudget("sweep_tune_serve_slo", TUNE_SERVE_SLO_S,
+                "tune_serve_s", cmp="le", model="staleness",
+                n_devices=8,
+                note="closed-loop bar: a retune generation (full "
+                     "sweep + winner train + publish/warm/canary/"
+                     "flip) lands inside the 300 s tune->serve SLO "
+                     "at D=8"),
+    SweepBudget("sweep_serial_blows_tune_slo", TUNE_SERVE_SLO_S,
+                "tune_serve_s", cmp="ge", model="staleness",
+                serial=True,
+                note="guard-the-model: the serial reference loop "
+                     "CANNOT meet the tune->serve SLO at the same "
+                     "shape — the scheduled mesh is load-bearing "
+                     "for closed-loop tuning"),
+)
+
+
+def sweep_budget_by_name(name: str) -> SweepBudget:
+    for b in SWEEP_BUDGETS:
+        if b.name == name:
+            return b
+    raise KeyError(name)
+
+
+def check_sweep_budgets(names: Optional[List[str]] = None
+                        ) -> List[Dict[str, object]]:
+    specs = (SWEEP_BUDGETS if names is None
+             else [sweep_budget_by_name(n) for n in names])
+    return [b.check() for b in specs]
+
+
+# ---------------------------------------------------------------------------
 # budget anchors — Layer-2 stale-entry reporting (r16)
 # ---------------------------------------------------------------------------
 # Every budget family above models a REAL entry point; rename that
@@ -1460,6 +1675,11 @@ BUDGET_ANCHORS: Dict[str, Tuple[Tuple[str, str], ...]] = {
     "freshness": (
         ("lightgbm_tpu/pipeline/daemon.py", "RefreshDaemon"),
         ("lightgbm_tpu/pipeline/staleness.py", "StalenessTracker"),
+    ),
+    "sweep": (
+        ("lightgbm_tpu/sweep/service.py", "SweepService"),
+        ("lightgbm_tpu/sweep/scheduler.py", "SweepScheduler"),
+        ("lightgbm_tpu/sweep/ledger.py", "SweepLedger"),
     ),
 }
 

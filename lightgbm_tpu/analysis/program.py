@@ -43,7 +43,8 @@ from .rules import (Finding, _ModuleAnalysis, apply_waivers, is_kernel_file)
 # the shared fault-site registry: module (dotted suffix) and the tuple
 # assignments that define it
 FAULTS_MODULE_SUFFIX = "faults"
-SITE_REGISTRY_NAMES = ("SERVING_SITES", "TRAINING_SITES", "PIPELINE_SITES")
+SITE_REGISTRY_NAMES = ("SERVING_SITES", "TRAINING_SITES", "PIPELINE_SITES",
+                       "SWEEP_SITES")
 
 # receivers that make a ``.check("site")`` call a fault consultation —
 # precision guard: budget specs also have .check() methods (no string

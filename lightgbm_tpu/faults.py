@@ -55,6 +55,22 @@ Pipeline (consulted by ``pipeline/daemon.py`` — the r15 refresh loop):
   modeling a post-flip health alarm; the daemon rolls the bank back to
   the prior version and re-anchors continuation on it.
 
+Sweep (consulted by ``sweep/service.py`` and ``pipeline/daemon.py`` —
+the r17 sweep-as-a-service loop):
+
+* ``sweep_segment`` — raises between fused-CV hyper-batch segments (or
+  before a host-engine config), modeling a preemption at an arbitrary
+  config/round of the grid; the service returns ``preempted`` and a
+  rerun resumes from the per-hyper-batch checkpoint bit-identically.
+* ``sweep_record`` — raises after a hyper-batch finishes, BEFORE its
+  results are committed to the ledger; the completed carry is already
+  checkpointed, so the resume replays only the final segment and lands
+  the identical ledger rows.
+* ``sweep_promote`` — raises between a completed sweep and the winning
+  config's promotion training, modeling a crash in the tune->serve
+  handoff; the daemon retries next tick, the finished ledger makes the
+  re-run a fast no-op, and the same winner promotes.
+
 A ``FaultInjector`` with no armed specs is a cheap no-op, so the hooks
 stay wired in production configurations.
 """
@@ -67,7 +83,8 @@ from typing import Dict, List, Optional
 SERVING_SITES = ("device_predict", "artifact_load", "compile", "clock")
 TRAINING_SITES = ("block_read", "device_put", "checkpoint_write", "gradient")
 PIPELINE_SITES = ("data_arrival", "continue_train", "artifact_push", "flip")
-SITES = SERVING_SITES + TRAINING_SITES + PIPELINE_SITES
+SWEEP_SITES = ("sweep_segment", "sweep_record", "sweep_promote")
+SITES = SERVING_SITES + TRAINING_SITES + PIPELINE_SITES + SWEEP_SITES
 
 
 class FaultError(RuntimeError):

@@ -250,7 +250,170 @@ def fused_cv_eligible(p: Params, feval, callbacks, train_set=None) -> bool:
         # constrained/randomized split selection needs the per-booster
         # mono_key plumbing; the fused batch program does not trace it yet
         return False
+    if train_set is not None and getattr(train_set, "is_streamed", False):
+        # the batch program consumes one device-resident X_binned; a
+        # streamed (BlockStore) Dataset has none — densify it first
+        # (pipeline/daemon.py does) or take the host loop
+        return False
     return True
+
+
+class FusedCVProgram:
+    """Stepper interface over one fused-cv program (r17).
+
+    Owns everything :func:`run_fused_cv_batch` used to set up inline —
+    fold masks, batched hyper scalars, the objective, the jitted
+    segment program — and exposes the execution as explicit
+    init/step/finalize calls plus a carry <-> numpy round-trip, so the
+    sweep service can CHECKPOINT a hyper-batch between segments through
+    the r13 protocol and resume it bit-identically.  The carry restore
+    is exact: every field is f32/i32/bool, so the npz round-trip loses
+    nothing, and per-round RNG is keyed by round index, so replaying
+    from a segment boundary reproduces the uninterrupted stream.
+    """
+
+    # the checkpointable state, in FusedCVCarry field order
+    CARRY_DTYPES = {"r": jnp.int32, "pred": jnp.float32,
+                    "bag": jnp.float32, "history": jnp.float32,
+                    "best_score": jnp.float32, "best_iter": jnp.int32,
+                    "done": jnp.bool_}
+
+    def __init__(self, train_set, param_list: Sequence[Params],
+                 fold_masks: np.ndarray, num_boost_round: int,
+                 early_stopping_rounds: int, seed: int):
+        p0 = param_list[0]
+        metrics = [m for m in p0.metric if m != "none"] or \
+            [default_metric_for_objective(p0.objective)]
+        self.metric_name = metrics[0]
+        self.num_boost_round = int(num_boost_round)
+
+        train_set.construct()
+        self._train_set = train_set
+        n_pad = int(train_set.row_mask.shape[0])
+        n = train_set.num_data()
+        n_folds, _ = fold_masks.shape
+        n_configs = len(param_list)
+        self.n_configs, self.n_folds, self.n_pad = n_configs, n_folds, n_pad
+
+        # [BATCH, n_pad] masks; padding rows excluded everywhere
+        tm = np.zeros((n_configs * n_folds, n_pad), np.float32)
+        vm = np.zeros((n_configs * n_folds, n_pad), np.float32)
+        for ci in range(n_configs):
+            for ki in range(n_folds):
+                b = ci * n_folds + ki
+                tm[b, :n] = fold_masks[ki]
+                vm[b, :n] = ~fold_masks[ki]
+        n_in_fold = tm.sum(axis=1).astype(np.float32)
+
+        def rep(vals):
+            return jnp.asarray(
+                np.repeat(np.asarray(vals, np.float32), n_folds))
+
+        hyper_b = HyperScalars(
+            learning_rate=rep([p.learning_rate for p in param_list]),
+            lambda_l1=rep([p.lambda_l1 for p in param_list]),
+            lambda_l2=rep([p.lambda_l2 for p in param_list]),
+            min_data_in_leaf=rep([p.min_data_in_leaf for p in param_list]),
+            min_sum_hessian=rep(
+                [p.min_sum_hessian_in_leaf for p in param_list]),
+            min_gain_to_split=rep(
+                [p.min_gain_to_split for p in param_list]),
+            max_depth=rep(
+                [p.max_depth for p in param_list]).astype(jnp.int32),
+            feature_fraction_bynode=rep(
+                [p.feature_fraction_bynode for p in param_list]),
+            top_rate=rep([p.top_rate for p in param_list]),
+            other_rate=rep([p.other_rate for p in param_list]),
+            max_delta_step=rep([p.max_delta_step for p in param_list]),
+            path_smooth=rep([p.path_smooth for p in param_list]),
+            linear_lambda=rep([p.linear_lambda for p in param_list]),
+        )
+        bag_frac_b = rep([p.bagging_fraction for p in param_list])
+        ff_b = rep([p.feature_fraction for p in param_list])
+
+        # all configs in a bucket share bagging_freq (bucketing key) —
+        # LightGBM's grid fixes it at 4 anyway (r/gridsearchCV.R:98)
+        bagging_freq = p0.bagging_freq if p0.bagging_fraction < 1.0 or any(
+            p.bagging_fraction < 1.0 for p in param_list) else 0
+
+        from ..objectives import create_objective
+
+        obj = create_objective(p0)
+        y_host = train_set.get_label()
+        w_host = (train_set.get_weight()
+                  if train_set.get_weight() is not None else np.ones(n))
+        if hasattr(obj, "prepare"):
+            obj.prepare(y_host, w_host)
+        num_class = (p0.num_class
+                     if p0.objective in ("multiclass", "multiclassova")
+                     else 1)
+        init = obj.init_score(y_host, w_host)  # [K] priors mc, scalar else
+        if num_class == 1:
+            init = float(init)
+        self._num_class = num_class
+        self._init_score = init
+
+        from .gbdt import resolve_hist_dtype
+
+        cats = np.flatnonzero(train_set.col_is_categorical)
+        cat_key = ((tuple(int(c) for c in cats), float(p0.cat_smooth),
+                    float(p0.cat_l2), int(p0.max_cat_threshold))
+                   if len(cats) else None)
+        hd = resolve_hist_dtype(p0, n_pad)
+        self._run_segment, self._init_carry, self._finalize = _fused_cv_fn(
+            _objective_static_key(obj, p0), p0.num_leaves,
+            train_set.num_bins, self.metric_name, float(p0.alpha),
+            float(p0.tweedie_variance_power), num_boost_round,
+            int(bagging_freq), n_configs, n_folds,
+            p0.extra.get("hist_impl", "auto"),
+            int(p0.extra.get("row_chunk", 131072)),
+            hd, cat_key, num_class, _fused_wave_width(p0, n_pad, hd),
+            bynode_off=all(p.feature_fraction_bynode >= 1.0
+                           for p in param_list))
+
+        self._tm_d = jnp.asarray(tm)
+        self._args = (
+            self._tm_d, jnp.asarray(vm), hyper_b, bag_frac_b, ff_b,
+            jnp.asarray(n_in_fold), jnp.int32(early_stopping_rounds),
+            jnp.asarray([p.early_stopping_min_delta for p in param_list],
+                        jnp.float32),
+            jax.random.PRNGKey(seed))
+        self.segment_rounds = int(p0.extra.get("cv_segment_rounds", 100))
+
+    def init(self) -> FusedCVCarry:
+        """Fresh round-0 carry (bag seeded to the train masks)."""
+        carry = self._init_carry(
+            self.n_pad,
+            jnp.asarray(self._init_score, jnp.float32)
+            if self._num_class > 1
+            else jnp.full((self.n_configs * self.n_folds,),
+                          self._init_score, jnp.float32))
+        return carry._replace(bag=self._tm_d)
+
+    def step(self, carry: FusedCVCarry, seg_end: int) -> FusedCVCarry:
+        """One device dispatch: rounds [carry.r, seg_end) with on-device
+        early stopping."""
+        ts = self._train_set
+        return self._run_segment(carry, jnp.int32(seg_end), ts.X_binned,
+                                 ts.y, ts.w, *self._args)
+
+    def done(self, carry: FusedCVCarry) -> bool:
+        return bool(jnp.all(carry.done)) \
+            or int(carry.r) >= self.num_boost_round
+
+    def finalize(self, carry: FusedCVCarry) -> FusedCVResult:
+        return self._finalize(carry)
+
+    def carry_arrays(self, carry: FusedCVCarry) -> dict:
+        """Carry -> host numpy dict, the r13 checkpoint payload shape."""
+        return {f: np.asarray(getattr(carry, f))
+                for f in FusedCVCarry._fields}
+
+    def restore_carry(self, arrays: dict) -> FusedCVCarry:
+        """Exact inverse of :meth:`carry_arrays`."""
+        return FusedCVCarry(**{
+            f: jnp.asarray(arrays[f], self.CARRY_DTYPES[f])
+            for f in FusedCVCarry._fields})
 
 
 def run_fused_cv_batch(
@@ -271,117 +434,33 @@ def run_fused_cv_batch(
     steady-state segment cost — compile + first-touch) and ``exec_s``
     (estimated pure execution) so sweep reports can separate the two
     (VERDICT r3: "instrument compile-vs-execute, then fix").
+
+    Since r17 this is a thin driver over :class:`FusedCVProgram` — the
+    sweep service uses the same stepper with checkpoints between
+    segments; this entry point keeps the original run-to-completion
+    contract bit-identical.
     """
-    p0 = param_list[0]
-    metrics = [m for m in p0.metric if m != "none"] or \
-        [default_metric_for_objective(p0.objective)]
-    metric_name = metrics[0]
-
-    train_set.construct()
-    n_pad = int(train_set.row_mask.shape[0])
-    n = train_set.num_data()
-    n_folds, _ = fold_masks.shape
-    n_configs = len(param_list)
-
-    # [BATCH, n_pad] masks; padding rows excluded everywhere
-    tm = np.zeros((n_configs * n_folds, n_pad), np.float32)
-    vm = np.zeros((n_configs * n_folds, n_pad), np.float32)
-    for ci in range(n_configs):
-        for ki in range(n_folds):
-            b = ci * n_folds + ki
-            tm[b, :n] = fold_masks[ki]
-            vm[b, :n] = ~fold_masks[ki]
-    n_in_fold = tm.sum(axis=1).astype(np.float32)
-
-    def rep(vals):
-        return jnp.asarray(np.repeat(np.asarray(vals, np.float32), n_folds))
-
-    hyper_b = HyperScalars(
-        learning_rate=rep([p.learning_rate for p in param_list]),
-        lambda_l1=rep([p.lambda_l1 for p in param_list]),
-        lambda_l2=rep([p.lambda_l2 for p in param_list]),
-        min_data_in_leaf=rep([p.min_data_in_leaf for p in param_list]),
-        min_sum_hessian=rep([p.min_sum_hessian_in_leaf for p in param_list]),
-        min_gain_to_split=rep([p.min_gain_to_split for p in param_list]),
-        max_depth=rep([p.max_depth for p in param_list]).astype(jnp.int32),
-        feature_fraction_bynode=rep(
-            [p.feature_fraction_bynode for p in param_list]),
-        top_rate=rep([p.top_rate for p in param_list]),
-        other_rate=rep([p.other_rate for p in param_list]),
-        max_delta_step=rep([p.max_delta_step for p in param_list]),
-        path_smooth=rep([p.path_smooth for p in param_list]),
-        linear_lambda=rep([p.linear_lambda for p in param_list]),
-    )
-    bag_frac_b = rep([p.bagging_fraction for p in param_list])
-    ff_b = rep([p.feature_fraction for p in param_list])
-
-    # all configs in a bucket share bagging_freq (bucketing key) — LightGBM's
-    # grid fixes it at 4 anyway (r/gridsearchCV.R:98)
-    bagging_freq = p0.bagging_freq if p0.bagging_fraction < 1.0 or any(
-        p.bagging_fraction < 1.0 for p in param_list) else 0
-
-    from ..objectives import create_objective
-
-    obj = create_objective(p0)
-    y_host = train_set.get_label()
-    w_host = (train_set.get_weight() if train_set.get_weight() is not None
-              else np.ones(n))
-    if hasattr(obj, "prepare"):
-        obj.prepare(y_host, w_host)
-    num_class = (p0.num_class
-                 if p0.objective in ("multiclass", "multiclassova") else 1)
-    init = obj.init_score(y_host, w_host)   # [K] priors mc, scalar else
-    if num_class == 1:
-        init = float(init)
-
-    from .gbdt import resolve_hist_dtype
-
-    cats = np.flatnonzero(train_set.col_is_categorical)
-    cat_key = ((tuple(int(c) for c in cats), float(p0.cat_smooth),
-                float(p0.cat_l2), int(p0.max_cat_threshold))
-               if len(cats) else None)
-    hd = resolve_hist_dtype(p0, n_pad)
-    run_segment, init_carry, finalize = _fused_cv_fn(
-        _objective_static_key(obj, p0), p0.num_leaves, train_set.num_bins,
-        metric_name, float(p0.alpha), float(p0.tweedie_variance_power),
-        num_boost_round, int(bagging_freq),
-        n_configs, n_folds, p0.extra.get("hist_impl", "auto"),
-        int(p0.extra.get("row_chunk", 131072)),
-        hd, cat_key, num_class, _fused_wave_width(p0, n_pad, hd),
-        bynode_off=all(p.feature_fraction_bynode >= 1.0
-                       for p in param_list))
-
-    tm_d = jnp.asarray(tm)
-    carry = init_carry(n_pad, jnp.asarray(init, jnp.float32)
-                       if num_class > 1
-                       else jnp.full((n_configs * n_folds,), init,
-                                     jnp.float32))
-    carry = carry._replace(bag=tm_d)
-    args = (tm_d, jnp.asarray(vm), hyper_b, bag_frac_b, ff_b,
-            jnp.asarray(n_in_fold), jnp.int32(early_stopping_rounds),
-            jnp.asarray([p.early_stopping_min_delta for p in param_list],
-                        jnp.float32),
-            jax.random.PRNGKey(seed))
-    seg = int(p0.extra.get("cv_segment_rounds", 100))
+    prog = FusedCVProgram(train_set, param_list, fold_masks,
+                          num_boost_round, early_stopping_rounds, seed)
+    carry = prog.init()
+    seg = prog.segment_rounds
     import time as _time
     if timings is not None:
         # isolate compile exactly: a seg_end=0 call compiles the full
         # program but its while_loop condition is immediately false, so
         # execution cost is one empty dispatch (~terminal latency)
         t0 = _time.perf_counter()
-        carry = run_segment(carry, jnp.int32(0), train_set.X_binned,
-                            train_set.y, train_set.w, *args)
+        carry = prog.step(carry, 0)
         jax.block_until_ready(carry.r)
         timings["compile_s"] = _time.perf_counter() - t0
     t_exec = _time.perf_counter()
     for seg_end in range(seg, num_boost_round + seg, seg):
-        carry = run_segment(carry, jnp.int32(min(seg_end, num_boost_round)),
-                            train_set.X_binned, train_set.y, train_set.w,
-                            *args)
+        carry = prog.step(carry, min(seg_end, num_boost_round))
         if bool(jnp.all(carry.done)) or int(carry.r) >= num_boost_round:
             break
     if timings is not None:
         timings["exec_s"] = _time.perf_counter() - t_exec
-    res = finalize(carry)
+    res = prog.finalize(carry)
     return (np.asarray(res.history), np.asarray(res.best_iter),
-            np.asarray(res.best_score), int(res.rounds_run), metric_name)
+            np.asarray(res.best_score), int(res.rounds_run),
+            prog.metric_name)

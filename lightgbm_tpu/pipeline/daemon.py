@@ -34,8 +34,10 @@ Fault sites consulted (shared ``lightgbm_tpu.faults`` registry):
 ``data_arrival`` (poll outage — retried, arrivals never lost),
 ``continue_train`` (preemption at a round boundary), ``artifact_push``
 (torn publish — the artifact is poisoned so the bank MUST catch it),
-``flip`` (post-flip health alarm -> rollback), plus every r12/r13 site
-the wrapped subsystems already consult.
+``flip`` (post-flip health alarm -> rollback), ``sweep_promote``
+(r17: a crash between a completed sweep and the winner's promotion —
+retried next tick, the finished ledger makes the re-run a fast no-op),
+plus every r12/r13/r17 site the wrapped subsystems already consult.
 """
 
 from __future__ import annotations
@@ -163,7 +165,18 @@ class RefreshDaemon:
         Injectable time source, shared fault registry, and optional
         per-stage simulated costs (seconds) charged into a
         ``SimClock`` — keys: ``dataset_build``, ``train_round``,
-        ``publish``, ``deploy``, ``flip``.
+        ``sweep``, ``publish``, ``deploy``, ``flip``.
+    sweep_grid / sweep_every (r17)
+        The closed tune->serve loop: with a config grid and
+        ``sweep_every=N``, every Nth data-bearing generation runs a
+        checkpointed :class:`~lightgbm_tpu.sweep.service.SweepService`
+        over the accumulated data first, adopts the leaderboard winner
+        into ``params``, COLD-trains it to the winner's best iteration,
+        and promotes through the same publish -> canary -> atomic-flip
+        path as a refresh (``retune()`` forces one immediately).
+        ``sweep_rounds``/``sweep_nfold``/``sweep_early_stopping``
+        bound the per-config CV; ``sweep_devices``/``sweep_hyper_batch``
+        shape the scheduler mesh.
     """
 
     def __init__(self, params: dict, state_dir: str, *,
@@ -178,10 +191,26 @@ class RefreshDaemon:
                  clock: Optional[Callable[[], float]] = None,
                  injector: Optional[FaultInjector] = None,
                  stage_costs: Optional[Dict[str, float]] = None,
-                 keep_artifacts: int = 4):
+                 keep_artifacts: int = 4,
+                 sweep_grid: Optional[List[dict]] = None,
+                 sweep_every: int = 0,
+                 sweep_rounds: int = 50,
+                 sweep_nfold: int = 3,
+                 sweep_early_stopping: int = 5,
+                 sweep_devices: int = 1,
+                 sweep_hyper_batch: int = 36):
         if refresh_rounds <= 0:
             raise ValueError(
                 f"refresh_rounds must be positive, got {refresh_rounds}")
+        if sweep_every > 0 and not sweep_grid:
+            raise ValueError(
+                "sweep_every > 0 requires a sweep_grid")
+        if sweep_grid is not None and sweep_nfold < 2:
+            raise ValueError(
+                f"sweep_nfold must be >= 2, got {sweep_nfold}")
+        if sweep_devices < 1:
+            raise ValueError(
+                f"sweep_devices must be >= 1, got {sweep_devices}")
         if keep_artifacts < 2:
             raise ValueError(
                 "keep_artifacts must be >= 2 (the previous version must "
@@ -208,6 +237,14 @@ class RefreshDaemon:
             clock=self.clock)
         self.tracker = StalenessTracker(slo_ms=staleness_slo_ms)
         self.poll_faults = 0
+        self.sweep_grid = [dict(r) for r in sweep_grid] if sweep_grid \
+            else None
+        self.sweep_every = int(sweep_every)
+        self.sweep_rounds = int(sweep_rounds)
+        self.sweep_nfold = int(sweep_nfold)
+        self.sweep_early_stopping = int(sweep_early_stopping)
+        self.sweep_devices = int(sweep_devices)
+        self.sweep_hyper_batch = int(sweep_hyper_batch)
 
         # guards the absorb-state (blocks/pending/retry/generation/live
         # pointers) against status()/snapshot() readers on other threads
@@ -215,6 +252,9 @@ class RefreshDaemon:
         self._blocks: List[Tuple[np.ndarray, np.ndarray]] = []
         self._pending: List[Arrival] = []
         self._retry = False
+        self._retry_mode: Optional[str] = None  # "refresh" | "sweep"
+        self._flips_since_sweep = 0
+        self._force_sweep = False
         self._ref_mapper = None
         self._live_path, self._gen = latest_artifact(self.models_dir)
         self._live_rounds = 0
@@ -251,9 +291,33 @@ class RefreshDaemon:
                 return {"event": "poll_fault", "error": str(e)}
         with self._lock:
             self._pending.extend(self.feed.poll())
-        if not self._pending and not self._retry:
+        if not self._pending and not self._retry and not self._force_sweep:
             return None
+        # a preempted generation finishes AS WHAT IT WAS before anything
+        # new starts: a half-done retune must not be restarted as a
+        # refresh (or vice versa) just because more data arrived
+        if self._retry:
+            if self._retry_mode == "sweep":
+                return self._run_sweep()
+            return self._run_refresh()
+        if self._sweep_due():
+            return self._run_sweep()
         return self._run_refresh()
+
+    def _sweep_due(self) -> bool:
+        if self._force_sweep:
+            return True
+        return bool(self.sweep_grid and self.sweep_every > 0
+                    and self._flips_since_sweep >= self.sweep_every)
+
+    def retune(self) -> Optional[dict]:
+        """Force a sweep generation on the next data-bearing tick (the
+        operator's "the hyperparameters have drifted" hook)."""
+        with self._lock:
+            if self.sweep_grid is None:
+                raise ValueError("retune() needs a sweep_grid")
+            self._force_sweep = True
+        return self.tick()
 
     def run_until_idle(self, max_ticks: int = 64) -> List[dict]:
         """Tick until a fully idle tick (drained feed, no retry)."""
@@ -282,6 +346,8 @@ class RefreshDaemon:
         rec.stamp("data_arrival", t_arr)
         rec.status = "training"
         rec.stamp("train_start", self.clock())
+        with self._lock:
+            self._retry_mode = "refresh"
 
         blocks = self._blocks + [(a.X, a.y) for a in self._pending]
         ds = Dataset.from_blocks(blocks, params=dict(self.params),
@@ -294,6 +360,17 @@ class RefreshDaemon:
         target = self._live_rounds + (self.refresh_rounds
                                       if self._live_path is not None
                                       else self.initial_rounds)
+        return self._train_publish_flip(gen, rec, ds, target,
+                                        init_model=self._live_path)
+
+    def _train_publish_flip(self, gen: int, rec, ds, target: int,
+                            init_model: Optional[str]) -> dict:
+        """The shared back half of a generation: train ``target`` rounds
+        (continuation when ``init_model`` is set, cold otherwise — the
+        retune path trains the winner from scratch because continuation
+        under changed hyperparameters is not the model the sweep
+        scored), then publish -> canary -> atomic flip, with every
+        failure mode absorbed into a retryable event."""
 
         def _round_cb(_booster, _i) -> None:
             self._charge("train_round")
@@ -307,7 +384,7 @@ class RefreshDaemon:
                 checkpoint_rounds=self.checkpoint_rounds,
                 resume=True, injector=self.injector,
                 round_callbacks=[_round_cb],
-                init_model=self._live_path)
+                init_model=init_model)
         except FaultError as e:
             rec.status = "preempted"
             rec.error = str(e)
@@ -371,6 +448,7 @@ class RefreshDaemon:
         self._absorb(gen)
         with self._lock:
             self._live_path, self._live_rounds = art, res.rounds_done
+            self._flips_since_sweep += 1
         shutil.rmtree(self._ckpt_dir(gen), ignore_errors=True)
         self._prune_artifacts()
         return {"event": "flipped", "generation": gen,
@@ -378,6 +456,128 @@ class RefreshDaemon:
                 "resumed_from": res.resumed_from,
                 "staleness_ms": self.tracker.staleness_ms(gen),
                 "report": report}
+
+    # -- one sweep (retune) generation ----------------------------------------
+    def _sweep_dir(self, gen: int) -> str:
+        return os.path.join(self.state_dir, "sweep", f"gen_{gen:04d}")
+
+    # sweep axes whose R/JSON round-trip may come back float-typed but
+    # that params require integral
+    _INT_AXES = ("num_leaves", "min_data_in_leaf", "bagging_freq",
+                 "max_depth", "max_bin", "nthread")
+
+    def _run_sweep(self) -> dict:
+        """One retune generation: sweep the grid over ALL accumulated
+        data, adopt the leaderboard winner, train and promote it through
+        the standard publish -> canary -> flip path.
+
+        Crash-anywhere mirrors the refresh contract: the sweep itself is
+        a checkpointed :class:`SweepService` keyed to a PER-GENERATION
+        directory (an old tune's completed ledger can never short-
+        circuit a new tune), ``sweep_promote`` faults and SIGTERM drains
+        return a retryable ``preempted`` event, and a retry re-enters as
+        a sweep (``_retry_mode``) — a finished ledger makes the re-run a
+        fast no-op that converges on the same winner."""
+        from ..sweep.service import SweepService
+
+        gen = self._gen + 1
+        blocks = self._blocks + [(a.X, a.y) for a in self._pending]
+        if not blocks:
+            # a forced retune before any data exists: stay armed, sweep
+            # on the first data-bearing tick instead
+            return {"event": "no_data", "generation": gen}
+        rec = self.tracker.begin(gen)
+        t_arr = min(a.t_arrival for a in self._pending) \
+            if self._pending else rec.stamps.get("data_arrival",
+                                                 self.clock())
+        if "data_arrival" in rec.stamps:
+            t_arr = min(t_arr, rec.stamps["data_arrival"])
+        rec.stamp("data_arrival", t_arr)
+        rec.status = "training"
+        rec.stamp("sweep_start", self.clock())
+        with self._lock:
+            self._retry_mode = "sweep"
+
+        if self._ref_mapper is None:
+            # no schema yet (a forced retune before any refresh):
+            # establish the one-schema-forever mapper the canonical way
+            ref = Dataset.from_blocks(blocks, params=dict(self.params))
+            with self._lock:
+                self._ref_mapper = ref.bin_mapper
+        # the fused sweep program needs one device-resident code matrix,
+        # not a BlockStore — densify under the pinned reference schema
+        ds = Dataset(np.concatenate([b[0] for b in blocks]),
+                     label=np.concatenate([b[1] for b in blocks]),
+                     params=dict(self.params))
+        ds.bin_mapper = self._ref_mapper
+        self._charge("dataset_build")
+
+        sweep_dir = self._sweep_dir(gen)
+        os.makedirs(sweep_dir, exist_ok=True)
+        svc = SweepService(
+            self.sweep_grid, ds, base_params=dict(self.params),
+            num_boost_round=self.sweep_rounds, nfold=self.sweep_nfold,
+            early_stopping_rounds=self.sweep_early_stopping,
+            seed=gen,  # new data -> new folds; retries of gen reuse them
+            ledger_path=os.path.join(sweep_dir, "ledger.json"),
+            checkpoint_dir=os.path.join(sweep_dir, "ckpt"),
+            n_devices=self.sweep_devices,
+            hyper_batch=self.sweep_hyper_batch,
+            injector=self.injector, clock=self.clock)
+        res = svc.run()
+        if res.preempted or not res.completed:
+            rec.status = "preempted"
+            rec.error = res.error or "sweep incomplete"
+            with self._lock:
+                self._retry = True
+            return {"event": "preempted", "generation": gen,
+                    "phase": "sweep", "units_done": res.units_done,
+                    "error": rec.error}
+        board = res.ledger.leaderboard()
+        if not board:
+            rec.status = "rejected"
+            rec.error = "sweep produced no completed configs"
+            self._absorb(gen)
+            return {"event": "rejected", "generation": gen,
+                    "stage": "sweep", "error": rec.error}
+        if self.injector is not None:
+            try:
+                self.injector.check("sweep_promote")
+            except FaultError as e:
+                rec.status = "preempted"
+                rec.error = str(e)
+                with self._lock:
+                    self._retry = True
+                return {"event": "preempted", "generation": gen,
+                        "phase": "sweep_promote", "error": str(e)}
+        rec.stamp("swept", self.clock())
+        self._charge("sweep")
+
+        winner = board[0]
+        from ..sweep.ledger import RESULT_COLUMNS
+        cfg = {}
+        for k, v in winner.items():
+            if k in RESULT_COLUMNS or k == "nthread":
+                continue
+            if k in self._INT_AXES and isinstance(v, float) \
+                    and v.is_integer():
+                v = int(v)
+            cfg[k] = v
+        best_iter = max(int(winner["iteration"]), 1)
+        with self._lock:
+            self.params.update(cfg)
+            self._force_sweep = False
+        rec.stamp("train_start", self.clock())
+        ev = self._train_publish_flip(gen, rec, ds, best_iter,
+                                      init_model=None)
+        if ev.get("event") == "flipped":
+            with self._lock:
+                self._flips_since_sweep = 0
+            ev = dict(ev, event="retuned", winner=dict(cfg),
+                      winner_score=float(winner["score"]),
+                      sweep_units=res.units_total,
+                      tune_s=rec.decomposition().get("tune"))
+        return ev
 
     def _absorb(self, gen: int) -> None:
         """Commit the pending arrivals + generation number (the data was
@@ -387,6 +587,7 @@ class RefreshDaemon:
             self._blocks.extend((a.X, a.y) for a in self._pending)
             self._pending = []
             self._retry = False
+            self._retry_mode = None
             self._gen = gen
 
     def _publish(self, booster, art: str) -> bool:
@@ -430,6 +631,8 @@ class RefreshDaemon:
             "pending_blocks": len(self._pending),
             "absorbed_blocks": len(self._blocks),
             "poll_faults": self.poll_faults,
+            "flips_since_sweep": self._flips_since_sweep,
+            "retry_mode": self._retry_mode,
             "staleness": self.tracker.snapshot(),
             "bank": self.bank.snapshot(),
         }

@@ -9,13 +9,16 @@ the data arrived — so the tracker owns the timeline: the
 into one :class:`RefreshRecord` and the decomposition falls out as plain
 differences on the daemon's (injectable, sim-friendly) clock.
 
-Stage timeline per generation::
+Stage timeline per generation (the ``sweep_start``/``swept`` pair only
+appears on r17 retune generations — a sweep runs between data arrival
+and the winner's training)::
 
-    data_arrival -> train_start -> trained -> artifact_saved
-                 -> canaried -> serving
+    data_arrival [-> sweep_start -> swept] -> train_start -> trained
+                 -> artifact_saved -> canaried -> serving
 
     staleness   = serving - data_arrival          (the SLO quantity)
     wait        = train_start - data_arrival      (daemon tick latency)
+    tune        = swept - sweep_start             (grid sweep, retunes)
     train       = trained - train_start           (N continuation rounds)
     publish     = artifact_saved - trained        (pack + atomic write)
     deploy      = canaried - artifact_saved       (ingest + warm + canary)
@@ -32,8 +35,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-STAGES = ("data_arrival", "train_start", "trained", "artifact_saved",
-          "canaried", "serving")
+STAGES = ("data_arrival", "sweep_start", "swept", "train_start",
+          "trained", "artifact_saved", "canaried", "serving")
 
 # terminal generation states the daemon records
 _STATUSES = ("pending", "training", "preempted", "rejected",
@@ -68,6 +71,7 @@ class RefreshRecord:
         """Per-stage durations (seconds) for the stamps present."""
         out: Dict[str, float] = {}
         pairs = (("wait", "data_arrival", "train_start"),
+                 ("tune", "sweep_start", "swept"),
                  ("train", "train_start", "trained"),
                  ("publish", "trained", "artifact_saved"),
                  ("deploy", "artifact_saved", "canaried"),

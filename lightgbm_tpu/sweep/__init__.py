@@ -1,0 +1,40 @@
+"""Sweep-as-a-service: distributed, preemptible grid search (ISSUE r17).
+
+The source paper's central artifact is a 108-config GridSearchCV sweep
+with a per-config crash checkpoint (r/gridsearchCV.R:104-119,
+paramGrid.RData).  Earlier rounds built the pieces — the r7 fused-CV
+hyper-batch runs a bucket of configs x folds as ONE XLA program, the
+r13 checkpoint protocol makes any round state durable, the r15 refresh
+daemon owns canary -> atomic flip — and this package is the service
+layer that composes them:
+
+* :class:`~.scheduler.SweepScheduler` shards the config grid over a
+  configs x devices 2-D mesh: configs pack into fused-CV hyper-batches
+  (bucketed by compile-time statics), hyper-batches spread over device
+  groups;
+* :class:`~.service.SweepService` executes the plan segment by segment,
+  checkpointing each hyper-batch's full carry through the r13 protocol
+  so a SIGTERM or injected fault at ANY config/round resumes
+  bit-identically (kill-anywhere sweep parity, JSON and RData ledger
+  codecs both);
+* :class:`~.ledger.SweepLedger` is the crash-safe resumable result
+  ledger (atomic fsync+rename saves; unfinished sentinels can never
+  rank on the leaderboard);
+* the r15 :class:`~lightgbm_tpu.pipeline.daemon.RefreshDaemon` drives
+  the whole thing as ``task=sweep``: a completed sweep auto-promotes
+  its winning config through canary -> atomic flip, closing the loop
+  from "hyperparameters drifted stale" to "re-tuned model serving".
+
+``lightgbm_tpu.utils.sweep`` remains as a thin compat surface over this
+package (``expand_grid`` / ``SweepLedger`` / ``run_grid_search``).
+"""
+
+from .ledger import RESULT_COLUMNS, SENTINEL, SweepLedger, expand_grid
+from .scheduler import SweepPlan, SweepScheduler, SweepUnit, fused_bucket_key
+from .service import SweepResult, SweepService, run_grid_search
+
+__all__ = [
+    "RESULT_COLUMNS", "SENTINEL", "SweepLedger", "expand_grid",
+    "SweepPlan", "SweepScheduler", "SweepUnit", "fused_bucket_key",
+    "SweepResult", "SweepService", "run_grid_search",
+]

@@ -84,9 +84,18 @@ def _payload_bytes(arrays: Dict[str, np.ndarray], meta: dict) -> bytes:
     return buf.getvalue()
 
 
-def save_checkpoint(booster, directory: str, *, injector=None,
-                    keep_last: int = 2) -> str:
-    """Write ``booster``'s full round state atomically; returns the path.
+def save_state_checkpoint(arrays: Dict[str, np.ndarray], meta: dict,
+                          directory: str, *, injector=None,
+                          keep_last: int = 2) -> str:
+    """Write an arbitrary round-state checkpoint atomically (r17).
+
+    The generic half of :func:`save_checkpoint`: any ``arrays`` + JSON
+    ``meta`` (which must carry an integer ``iter`` naming the
+    generation) gets the full durability protocol — versioned header,
+    payload sha256, per-field crc32s, tmp+fsync+``os.replace``, and
+    ``keep_last`` pruning.  The sweep service checkpoints fused-CV
+    hyper-batch carries through this path so a sweep killed at any
+    config/round resumes from the same machinery training does.
 
     ``injector`` is consulted at the ``checkpoint_write`` site AFTER the
     tmp file is written and BEFORE the rename — the exact window where a
@@ -95,7 +104,6 @@ def save_checkpoint(booster, directory: str, *, injector=None,
     are pruned (oldest first); keep_last >= 2 keeps a fallback
     generation behind the newest.
     """
-    arrays, meta = booster.checkpoint_state()
     payload = _payload_bytes(arrays, meta)
     header = (CKPT_MAGIC
               + np.uint32(CKPT_FORMAT_VERSION).tobytes()
@@ -119,6 +127,19 @@ def save_checkpoint(booster, directory: str, *, injector=None,
         for old in list_checkpoints(directory)[:-keep_last]:
             os.unlink(old)
     return path
+
+
+def save_checkpoint(booster, directory: str, *, injector=None,
+                    keep_last: int = 2) -> str:
+    """Write ``booster``'s full round state atomically; returns the path.
+
+    Delegates to :func:`save_state_checkpoint` with the booster's own
+    state snapshot — see there for the durability protocol and the
+    ``checkpoint_write`` fault window.
+    """
+    arrays, meta = booster.checkpoint_state()
+    return save_state_checkpoint(arrays, meta, directory,
+                                 injector=injector, keep_last=keep_last)
 
 
 def list_checkpoints(directory: str) -> List[str]:

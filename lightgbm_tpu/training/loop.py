@@ -54,23 +54,34 @@ class PreemptionGuard:
     training loop polls ``requested`` at round boundaries so the
     in-flight round always completes.  Restores the previous handler on
     exit, so process signal semantics outside the guarded loop stay
-    intact."""
+    intact.
+
+    Reentrant (r17): the sweep service holds ONE guard across a whole
+    grid while each winner/config training re-enters it through
+    ``train_resumable(guard=...)`` — the handler installs at depth 0
+    and restores at depth 0, and one latched SIGTERM drains every
+    nesting level."""
 
     def __init__(self, signum: int = signal.SIGTERM):
         self.signum = signum
         self.requested = False
         self._prev = None
+        self._depth = 0
 
     def __enter__(self) -> "PreemptionGuard":
-        def _on_term(signo, frame):
-            self.requested = True
+        if self._depth == 0:
+            def _on_term(signo, frame):
+                self.requested = True
 
-        self._prev = signal.signal(self.signum, _on_term)
+            self._prev = signal.signal(self.signum, _on_term)
+        self._depth += 1
         return self
 
     def __exit__(self, *exc) -> None:
-        signal.signal(self.signum, self._prev)
-        self._prev = None
+        self._depth -= 1
+        if self._depth == 0:
+            signal.signal(self.signum, self._prev)
+            self._prev = None
         return None
 
 
@@ -87,6 +98,7 @@ def train_resumable(
     round_callbacks: Optional[List[Callable]] = None,
     finite_screen: bool = True,
     init_model: Optional[str] = None,
+    guard: Optional[PreemptionGuard] = None,
 ) -> TrainResult:
     """Train with checkpoint/resume + preemption drain; see module doc.
 
@@ -94,6 +106,11 @@ def train_resumable(
     ``cb(booster, round_index)`` — the chaos tests use one to deliver a
     real SIGTERM at an exact round.  ``resume`` may also be a checkpoint
     path to pin the exact artifact to resume from.
+
+    ``guard`` (r17) shares an outer :class:`PreemptionGuard` (it is
+    reentrant): a SIGTERM latched anywhere in an enclosing sweep drains
+    this training too, and one already latched BEFORE this call makes
+    the run drain at its first round boundary instead of being missed.
 
     ``init_model`` (r15) seeds the run by CONTINUING a saved model file
     (``.txt``/``.json``/packed ``.npz``) when no checkpoint exists yet —
@@ -151,7 +168,8 @@ def train_resumable(
                           f"kept): {e}")
 
     preempted = False
-    with PreemptionGuard() as guard:
+    guard = guard if guard is not None else PreemptionGuard()
+    with guard:
         while booster._iter < num_boost_round:
             i = booster._iter
             if injector is not None:

@@ -251,5 +251,11 @@ def write_rdata(path: str, name: str, columns: Dict[str, list]) -> None:
     hw.i4(0x030401)   # writer R version (3.4.1, the reference's kernel)
     hw.i4(0x020300)   # min reader version (2.3.0)
     payload = bytes(header) + bytes(hw.out) + bytes(w.out)
-    with gzip.open(path, "wb") as f:
-        f.write(payload)
+    # mtime pinned to 0 and FNAME suppressed so the gzip wrapper is
+    # byte-deterministic regardless of the (tmp) filename it was written
+    # under: the sweep's kill/resume parity compares RData ledgers as
+    # FILES
+    with open(path, "wb") as raw:
+        with gzip.GzipFile(filename="", fileobj=raw, mode="wb",
+                           mtime=0) as f:
+            f.write(payload)

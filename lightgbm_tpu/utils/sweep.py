@@ -1,258 +1,21 @@
-"""Grid-search sweep driver with a crash-safe, resumable ledger.
+"""Compatibility surface for the r2-era sweep helpers (moved in r17).
 
-TPU-native replacement for the reference's serial 108-config loop
-(r/gridsearchCV.R:104-119, also the PNG screenshot): ``expand_grid`` builds
-the cartesian parameter grid with ``iteration``/``score`` result columns
-riding along, and ``run_grid_search`` executes ``cv`` per row, checkpointing
-the ledger after **every** config exactly like the reference's
-``save(paramGrid, file=...)`` "if lgb crashes" pattern (r/gridsearchCV.R:118)
-— but idempotently resumable (completed rows are skipped on rerun), with the
-same -1 sentinels paramGrid.RData uses for unfinished rows (SURVEY.md §5
-"Failure detection").  Ledger format follows the path suffix: ``.RData``
-reads/writes R's actual serialization (byte-compatible with the reference's
-``save()``/``load()`` checkpoint — utils.rdata), anything else is JSON.
+The grid/ledger/search machinery that lived here since r2 grew into the
+``lightgbm_tpu.sweep`` subsystem (scheduler + checkpointed service +
+daemon integration).  This module stays as the stable import path the
+examples, bench, and external callers use — everything re-exports from
+the new package:
+
+* :func:`expand_grid`, :class:`SweepLedger`, ``RESULT_COLUMNS``,
+  ``SENTINEL`` -> :mod:`lightgbm_tpu.sweep.ledger`
+* :func:`run_grid_search` -> :mod:`lightgbm_tpu.sweep.service`
 """
 
 from __future__ import annotations
 
-import itertools
-import json
-import os
-import time
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from ..sweep.ledger import (RESULT_COLUMNS, SENTINEL, SweepLedger,
+                            expand_grid)
+from ..sweep.service import run_grid_search
 
-import numpy as np
-
-RESULT_COLUMNS = ("iteration", "score")
-SENTINEL = -1.0  # paramGrid.RData's marker for crashed/unfinished rows
-
-
-def expand_grid(**axes: Sequence[Any]) -> List[Dict[str, Any]]:
-    """R ``expand.grid`` equivalent: cartesian product, first axis fastest
-    (R's column-major convention, so row order matches the reference grid)."""
-    names = list(axes.keys())
-    values = [list(axes[n]) for n in names]
-    rows = []
-    for combo in itertools.product(*reversed(values)):
-        row = dict(zip(reversed(names), combo))
-        rows.append({n: row[n] for n in names})
-    return rows
-
-
-class SweepLedger:
-    """Resumable grid ledger: one record per config with status + results."""
-
-    def __init__(self, grid: List[Dict[str, Any]], path: Optional[str] = None):
-        self.path = path
-        self.rows: List[Dict[str, Any]] = []
-        for cfg in grid:
-            row = {c: SENTINEL for c in RESULT_COLUMNS}
-            row.update(cfg)
-            self.rows.append(row)
-        if path and os.path.exists(path):
-            self._merge_existing(path)
-
-    @staticmethod
-    def _is_rdata(path: str) -> bool:
-        return path.lower().endswith(".rdata")
-
-    def _merge_existing(self, path: str) -> None:
-        if self._is_rdata(path):
-            from .rdata import read_rdata
-            dfs = read_rdata(path)
-            df = dfs.get("paramGrid") or next(iter(dfs.values()), {})
-            cols = list(df.keys())
-            nrow = len(df[cols[0]]) if cols else 0
-            saved_rows = [{c: df[c][i] for c in cols} for i in range(nrow)]
-        else:
-            with open(path) as f:
-                saved = json.load(f)
-            saved_rows = saved.get("rows", [])
-        for i, srow in enumerate(saved_rows):
-            if i >= len(self.rows):
-                break
-            mine = {k: v for k, v in self.rows[i].items()
-                    if k not in RESULT_COLUMNS}
-            theirs = {k: v for k, v in srow.items() if k not in RESULT_COLUMNS}
-            if self._cfg_equal(mine, theirs) and \
-                    srow.get("iteration", SENTINEL) != SENTINEL:
-                merged = dict(self.rows[i])
-                merged.update({c: srow[c] for c in RESULT_COLUMNS
-                               if c in srow})
-                self.rows[i] = merged
-
-    @staticmethod
-    def _cfg_equal(a: Dict[str, Any], b: Dict[str, Any]) -> bool:
-        """Config equality across serializations (R numerics come back as
-        floats: num_leaves 31 vs 31.0 must still match)."""
-        if set(a) != set(b):
-            return False
-        for k in a:
-            x, y = a[k], b[k]
-            if isinstance(x, (int, float)) and isinstance(y, (int, float)):
-                if abs(float(x) - float(y)) > 1e-9 * max(1.0, abs(float(x))):
-                    return False
-            elif x != y:
-                return False
-        return True
-
-    def done(self, i: int) -> bool:
-        return self.rows[i]["iteration"] != SENTINEL
-
-    def record(self, i: int, best_iter: int, best_score: float) -> None:
-        self.rows[i]["iteration"] = int(best_iter)
-        self.rows[i]["score"] = float(best_score)
-        self.save()
-
-    def save(self) -> None:
-        if not self.path:
-            return
-        tmp = self.path + ".tmp"
-        if self._is_rdata(self.path):
-            from .rdata import write_rdata
-            cols = list(self.rows[0].keys()) if self.rows else []
-            write_rdata(tmp, "paramGrid",
-                        {c: [r[c] for r in self.rows] for c in cols})
-        else:
-            with open(tmp, "w") as f:
-                json.dump({"rows": self.rows, "saved_at": time.time()}, f,
-                          indent=1)
-        os.replace(tmp, self.path)
-
-    def leaderboard(self) -> List[Dict[str, Any]]:
-        """Rows ordered by score descending (scores are sign-flipped so
-        higher is better — the R convention; r/gridsearchCV.R:122)."""
-        return sorted((r for r in self.rows if r["iteration"] != SENTINEL),
-                      key=lambda r: -r["score"])
-
-    def to_numpy(self):
-        cols = list(self.rows[0].keys())
-        return cols, np.array([[r[c] for c in cols] for r in self.rows],
-                              dtype=np.float64)
-
-
-def run_grid_search(
-    grid: List[Dict[str, Any]],
-    train_set,
-    base_params: Optional[Dict[str, Any]] = None,
-    num_boost_round: int = 1000,
-    nfold: int = 5,
-    early_stopping_rounds: int = 5,
-    ledger_path: Optional[str] = None,
-    seed: int = 0,
-    verbose: bool = True,
-    cv_fn: Optional[Callable] = None,
-    engine: str = "fused",
-) -> SweepLedger:
-    """Execute the reference's sweep loop (r/gridsearchCV.R:104-119).
-
-    Per config: 5-fold CV with early stopping; ``best_iter``/``best_score``
-    written back into the ledger; ledger checkpointed each iteration.
-    Re-running with the same ledger_path skips completed rows.
-
-    ``engine="fused"`` (default) buckets configs sharing the shape-static
-    params (num_leaves, bagging_freq) and runs each bucket's cv trainings as
-    ONE on-device batched program (folds × configs vmapped, rounds in a
-    `lax.while_loop` with on-device early stopping) — this is the headline
-    TPU win over the reference's 30-minute serial sweep (SURVEY.md §3.3).
-    ``engine="host"`` reproduces the serial per-config loop.
-    """
-    from ..config import parse_params
-    from ..engine import cv as _cv
-    from ..metrics import get_metric
-    from ..models.fused import fused_cv_eligible, run_fused_cv_batch
-
-    ledger = SweepLedger(grid, ledger_path)
-    base = dict(base_params or {})
-
-    if engine == "fused" and cv_fn is None:
-        parsed = []
-        for cfg in grid:
-            params = dict(base)
-            params.update(cfg)
-            parsed.append(parse_params(params, warn_unknown=False))
-        if all(fused_cv_eligible(p, None, None, train_set) for p in parsed):
-            return _run_fused(grid, parsed, train_set, ledger,
-                              num_boost_round, nfold,
-                              early_stopping_rounds, seed, verbose)
-        if verbose:
-            print("fused engine ineligible for this grid; "
-                  "falling back to host loop")
-
-    cv_fn = cv_fn or _cv
-    for i, cfg in enumerate(grid):
-        if ledger.done(i):
-            if verbose:
-                print(f"[{i + 1}/{len(grid)}] already done, skipping")
-            continue
-        if verbose:
-            print(f"[{i + 1}/{len(grid)}]")
-        params = dict(base)
-        params.update(cfg)
-        fit = cv_fn(params, train_set, num_boost_round=num_boost_round,
-                    nfold=nfold, early_stopping_rounds=early_stopping_rounds,
-                    seed=seed, stratified=False)
-        ledger.record(i, fit.best_iter, fit.best_score)
-    return ledger
-
-
-def _run_fused(grid, parsed, train_set, ledger, num_boost_round, nfold,
-               early_stopping_rounds, seed, verbose) -> "SweepLedger":
-    """Bucket configs by shape-statics and run each bucket as one program."""
-    from ..metrics import get_metric
-    from ..models.fused import run_fused_cv_batch
-    from ..config import default_metric_for_objective
-
-    train_set.construct()
-    n = train_set.num_data()
-    rng = np.random.default_rng(seed)
-    assign = rng.permutation(n) % nfold
-    fold_masks = np.stack([assign != k for k in range(nfold)])
-
-    buckets: Dict[Any, List[int]] = {}
-    for i, p in enumerate(parsed):
-        if ledger.done(i):
-            continue
-        # bucket key = everything the fused program treats as compile-time
-        # static, INCLUDING objective scalars (a grid axis over e.g.
-        # quantile alpha must not share one objective instance).
-        # learning_rate also buckets — not for compilation (it is traced)
-        # but because a bucket runs until its SLOWEST config early-stops,
-        # and stopping round is dominated by lr (mixing lr=0.1 with lr=0.01
-        # makes the fast configs idle-run ~5x their needed rounds).
-        key = (p.num_leaves, p.bagging_freq if p.bagging_fraction < 1 else 0,
-               p.objective, p.num_class, train_set.num_bins, p.alpha,
-               p.sigmoid, p.scale_pos_weight, p.is_unbalance, p.fair_c,
-               p.poisson_max_delta_step, p.learning_rate)
-        buckets.setdefault(key, []).append(i)
-
-    stats = {"buckets": [], "compile_s": 0.0, "exec_s": 0.0,
-             "rounds_total": 0}
-    for key, idxs in sorted(buckets.items()):
-        if verbose:
-            print(f"fused bucket num_leaves={key[0]} bagging_freq={key[1]}: "
-                  f"{len(idxs)} configs x {nfold} folds")
-        t0 = time.time()
-        timings: Dict[str, float] = {}
-        hist, best_iters, best_raw, rounds, metric_name = run_fused_cv_batch(
-            train_set, [parsed[i] for i in idxs], fold_masks,
-            num_boost_round, early_stopping_rounds, seed, timings=timings)
-        hib = get_metric(metric_name).higher_better
-        for j, i in enumerate(idxs):
-            raw = float(best_raw[j])
-            ledger.rows[i]["iteration"] = int(best_iters[j])
-            ledger.rows[i]["score"] = raw if hib else -raw
-        ledger.save()
-        el = time.time() - t0
-        stats["buckets"].append(
-            {"num_leaves": key[0], "configs": len(idxs), "s": round(el, 2),
-             "rounds": rounds, **{k: round(v, 2)
-                                  for k, v in timings.items()}})
-        stats["compile_s"] += timings.get("compile_s", 0.0)
-        stats["exec_s"] += timings.get("exec_s", 0.0)
-        stats["rounds_total"] += rounds
-        if verbose:
-            print(f"  bucket done in {el:.1f}s ({rounds} rounds run, "
-                  f"compile {timings.get('compile_s', 0):.1f}s)")
-    ledger.sweep_stats = stats
-    return ledger
+__all__ = ["RESULT_COLUMNS", "SENTINEL", "SweepLedger", "expand_grid",
+           "run_grid_search"]

@@ -25,7 +25,8 @@ from lightgbm_tpu.analysis.budgets import (FRESHNESS_BUDGETS,
 from lightgbm_tpu.data.sketch import schema_digest
 from lightgbm_tpu.dataset import Dataset
 from lightgbm_tpu.faults import (PIPELINE_SITES, SERVING_SITES, SITES,
-                                 TRAINING_SITES, FaultInjector, FaultSpec)
+                                 SWEEP_SITES, TRAINING_SITES,
+                                 FaultInjector, FaultSpec)
 from lightgbm_tpu.models.gbdt import Booster
 from lightgbm_tpu.pipeline import (ArrivalFeed, DirectoryFeed, RefreshDaemon,
                                    RefreshRecord, SimClock, StalenessTracker,
@@ -167,7 +168,8 @@ def test_from_blocks_reference_rejections():
 def test_pipeline_sites_and_shim_surface():
     assert PIPELINE_SITES == ("data_arrival", "continue_train",
                               "artifact_push", "flip")
-    assert SITES == SERVING_SITES + TRAINING_SITES + PIPELINE_SITES
+    assert SITES == (SERVING_SITES + TRAINING_SITES + PIPELINE_SITES
+                     + SWEEP_SITES)
     inj = FaultInjector()
     assert set(PIPELINE_SITES) <= set(inj.hits)
     # the serving shim keeps its pre-move surface, same objects

@@ -535,7 +535,8 @@ def test_gl010_noninjectorish_check_is_ignored():
 @pytest.mark.lint
 def test_real_registry_has_no_drift_and_pipeline_sites_covered():
     """The repo's own faults.SITES registry: every site consulted, every
-    site chaos-tested — including all four r15 PIPELINE_SITES."""
+    site chaos-tested — including all four r15 PIPELINE_SITES and
+    all three r17 SWEEP_SITES."""
     from lightgbm_tpu import faults
     from lightgbm_tpu.analysis.engine import (PACKAGE_ROOT, REPO_ROOT,
                                               _read_sources)
@@ -545,9 +546,11 @@ def test_real_registry_has_no_drift_and_pipeline_sites_covered():
     assert fault_site_findings(prog, tests) == []
     assert set(faults.PIPELINE_SITES) == {
         "data_arrival", "continue_train", "artifact_push", "flip"}
+    assert set(faults.SWEEP_SITES) == {
+        "sweep_segment", "sweep_record", "sweep_promote"}
     # and the drift check is not vacuous: drop the test tree and the
     # coverage direction must be able to fire
-    assert len(faults.SITES) == 12
+    assert len(faults.SITES) == 15
 
 
 # ---------------------------------------------------------------------------

@@ -78,8 +78,9 @@ def _streamed(block_rows=256, seed=0, **extra):
 def test_shared_registry_and_serving_backward_compat():
     assert set(TRAINING_SITES) == {"block_read", "device_put",
                                    "checkpoint_write", "gradient"}
-    from lightgbm_tpu.faults import PIPELINE_SITES
-    assert SITES == SERVING_SITES + TRAINING_SITES + PIPELINE_SITES
+    from lightgbm_tpu.faults import PIPELINE_SITES, SWEEP_SITES
+    assert SITES == (SERVING_SITES + TRAINING_SITES + PIPELINE_SITES
+                     + SWEEP_SITES)
     # the serving shim must re-export the SAME objects, training sites
     # included, so existing serving chaos code keeps working unchanged
     from lightgbm_tpu.serving import faults as sfaults

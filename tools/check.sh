@@ -104,3 +104,14 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py \
 echo "== freshness (refresh pipeline + staleness SLO) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_freshness.py -q \
   -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# 9. sweep: the r17 tune surface — scheduler mesh plans, crash-safe
+#    ledger (atomic saves, sentinel-proof leaderboard, RData/JSON
+#    merge), kill-anywhere hyper-batch resume with FILE-level byte
+#    parity on both codecs, the daemon's sweep -> canary -> flip
+#    retune loop with sweep_promote chaos, and the task=sweep CLI
+#    contract.  The configs/hour + tune->serve budget models already
+#    ran in the graftlint layer above (sweep section).
+echo "== sweep (distributed hyperparameter sweep + retune loop) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_sweep.py -q \
+  -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
