@@ -163,13 +163,20 @@ def tiny_packed_forest(num_trees: int = 3, num_features: int = 2):
         bin_mapper_dict=mapper.to_dict()).validate()
 
 
-def serving_predict_counts(bucket: int = 8):
+def serving_predict_counts(bucket: int = 8, stub: bool = False):
     """(fusions, custom_calls) of one packed-forest predict program at a
-    fixed bucket shape — the whole program (the traversal while-loop is
-    capacity-bounded and unrolls into the counted bodies)."""
-    import jax.numpy as jnp
-    import numpy as np
+    fixed bucket shape — the whole program.
 
+    r18: the device path is the fused predict mega-kernel
+    (``ops.predict.predict_forest_pallas``).  ``stub=True`` swaps the
+    Pallas call for a pure_callback so the CPU-compiled HLO shows the
+    launch structure a TPU build has — XLA-side fusions plus ONE
+    custom-call per class (interpret-mode Pallas INLINES the kernel
+    body on CPU, inflating the fused count the same way the grower
+    stub fixes)."""
+    import jax.numpy as jnp
+
+    from ..ops import predict as predict_mod
     from ..serving.runtime import PredictorRuntime
 
     rt = PredictorRuntime(tiny_packed_forest(), max_bucket=max(bucket, 1),
@@ -177,9 +184,13 @@ def serving_predict_counts(bucket: int = 8):
     codes = jnp.zeros((bucket, rt.packed.num_feature()), jnp.int32)
     mask = jnp.ones((bucket,), jnp.float32)
     fn = rt._build_fn(raw_score=False)
-    txt = fn.lower(codes, mask, jnp.int32(rt.packed.num_trees)).compile(
-    ).as_text()
-    del np
+    old = predict_mod._PREDICT_OPCOUNT_STUB
+    predict_mod._PREDICT_OPCOUNT_STUB = stub
+    try:
+        txt = fn.lower(codes, mask,
+                       jnp.int32(rt.packed.num_trees)).compile().as_text()
+    finally:
+        predict_mod._PREDICT_OPCOUNT_STUB = old
     return fusion_count(txt), custom_call_count(txt)
 
 
@@ -246,7 +257,7 @@ class LaunchBudget:
             f, c = split_iter_counts(self.fuse_split, e=self.e,
                                      stub=self.stub)
         elif self.kind == "serving_predict":
-            f, c = serving_predict_counts(self.bucket)
+            f, c = serving_predict_counts(self.bucket, stub=self.stub)
         else:
             raise ValueError(f"unknown budget kind {self.kind!r}")
         return f + c
@@ -278,10 +289,19 @@ LAUNCH_BUDGETS: Tuple[LaunchBudget, ...] = (
     LaunchBudget("cv_tpu_model", 8, e=8, stub=True,
                  note="the r7 tentpole: >=3x drop vs the 50/iter r4 "
                       "TPU-measured baseline"),
-    LaunchBudget("serving_predict_b8", 6, kind="serving_predict",
+    LaunchBudget("serving_predict_b8", 12, kind="serving_predict",
                  bucket=8,
-                 note="packed-forest bucket program, whole-program count "
-                      "(measured 3 on the r8 jax pin)"),
+                 note="fused predict bucket program, interpret-mode "
+                      "Pallas inlined; CPU regression pin (measured 10 "
+                      "at the r18 switch to the mega-kernel; the legacy "
+                      "per-node program measured 3 on the r8 pin)"),
+    LaunchBudget("serving_predict_tpu_model", 5, kind="serving_predict",
+                 bucket=8, stub=True,
+                 note="XLA fusions + 1 mega-kernel custom-call per "
+                      "class = TPU launches per dispatch (measured 3+1 "
+                      "at r18); depth-INDEPENDENT — the r14 per-node "
+                      "path launched its traversal fusions once per "
+                      "depth step"),
 )
 
 
@@ -345,6 +365,51 @@ def serving_recompile_sweep(max_bucket: int = 64) -> Dict[str, object]:
             "ok": first <= limit and second == 0,
             "note": "bucket ladder: <= log2(max_bucket)+1 programs, "
                     "repeat sweep hits cache only"}
+
+
+def serving_warm_recompile(max_bucket: int = 16) -> Dict[str, object]:
+    """r18 warm-coverage guarantee on a QUANTIZED runtime: ``warm()``
+    keys on the FULL compile key ``(bucket, raw_score, route)``, so
+    after warming both raw_score settings every traffic-path program
+    already exists — a sweep over all buckets and both settings
+    compiles NOTHING.  With >=2 devices visible the runtime gets a dp
+    mesh so shard programs ride the same contract; on a single-device
+    host the spec degrades to the "single" route (the dp/tp coverage
+    then lives in tests/test_predict_fused.py under the virtual mesh)."""
+    import numpy as np
+
+    rt = None
+    try:
+        import jax
+
+        from ..serving.runtime import PredictorRuntime
+
+        meshed = jax.local_device_count() >= 2
+        kw = ({"mesh_devices": 2, "shard_policy": "dp"} if meshed else {})
+        rt = PredictorRuntime(tiny_packed_forest(), max_bucket=max_bucket,
+                              donate=False, forest_precision="int8", **kw)
+        warmed = rt.warm(raw_score=False) + rt.warm(raw_score=True)
+        keys = len(rt.warmed_keys)
+        before = rt.num_compiles
+        rng = np.random.RandomState(1)
+        sizes = sorted({1, 2, max_bucket}
+                       | {int(x) for x in rng.randint(1, max_bucket + 1,
+                                                      size=8)})
+        for n in sizes:
+            for raw in (False, True):
+                rt.predict(rng.randn(n, rt.packed.num_feature()),
+                           raw_score=raw)
+        traffic = rt.num_compiles - before
+    finally:
+        del rt
+    limit = 2 * max_bucket.bit_length()     # 2 raw_score x bucket ladder
+    return {"name": f"serving_warm_full_key_b{max_bucket}"
+                    + ("_dp" if meshed else ""),
+            "compiles": warmed, "warmed_keys": keys,
+            "recompiles_on_repeat": traffic, "max_compiles": limit,
+            "ok": warmed <= limit and keys == warmed and traffic == 0,
+            "note": "int8 warm() covers the full (bucket, raw_score, "
+                    "route) key: zero traffic-path compiles after warm"}
 
 
 def fused_train_step_recompiles(n_hyper_batches: int = 3
@@ -415,6 +480,7 @@ def check_recompile_specs(serving_max_bucket: int = 64,
                           n_hyper_batches: int = 3
                           ) -> List[Dict[str, object]]:
     return [serving_recompile_sweep(serving_max_bucket),
+            serving_warm_recompile(),
             fused_train_step_recompiles(n_hyper_batches)]
 
 
@@ -989,6 +1055,128 @@ def serve_mesh_dispatch_model(n_devices: int, dispatch_ms: float = 2.0,
             "overhead_frac": fixed / compute if compute > 0 else 0.0}
 
 
+# -- r18 fused-predict kernel model ------------------------------------------
+#
+#   PREDICT_SOA_NODE_BYTES — HBM bytes per ForestSoA node slot by
+#       precision.  INTENTIONALLY equal to ops.quantize.PACKED_NODE_BYTES:
+#       the depth-major SoA keeps the compact storage dtypes (i16 feat +
+#       u8 threshold + 2x i16 child + i8/bf16 leaf + bool parity byte),
+#       so residency cost per node is unchanged by the r18 re-layout —
+#       pinned by tests/test_predict_fused.py against the live arrays.
+#   R14_PREDICT_STEP_FUSIONS / _EPILOGUE — the r14 per-node path's launch
+#       structure: each traversal depth step re-launched its gather/
+#       compare/route fusion group (3/step, measured on the r8 pin at
+#       depth_cap=1: 3 whole-program fusions) plus a widen/accumulate
+#       epilogue.  The fused kernel replaces ALL of it with one
+#       custom-call per class — depth runs inside the kernel's
+#       fori_loop, so launches stop scaling with depth_cap entirely.
+
+PREDICT_SOA_NODE_BYTES = {"f32": 21, "bf16": 10, "int8": 9}
+R14_PREDICT_STEP_FUSIONS = 3
+R14_PREDICT_EPILOGUE_FUSIONS = 2
+
+
+def predict_kernel_time(num_trees: int = 800, node_slots: int = 509,
+                        depth_cap: int = 12, num_class: int = 1,
+                        precision: str = "int8", bucket: int = 16384,
+                        num_features: int = 32) -> Dict[str, float]:
+    """Launch/VMEM/HBM model of one fused predict dispatch.
+
+    Reference shape: an 800-tree, 255-leaf (509 node slots) int8 forest
+    serving full 16k buckets of 32 features — the PERF.md serving
+    reference.  Returns:
+
+    * ``launches_fused`` / ``launches_r14_model`` / ``launch_drop_x`` —
+      TPU launches per dispatch, fused (XLA prologue fusions + one
+      mega-kernel custom-call per class, depth-independent) vs the r14
+      per-node path (its traversal fusion group re-launched every depth
+      step);
+    * ``vmem_block_mb`` — peak VMEM of one grid step: the widened f32
+      table tiles, the bins block, and the dominant [Tc, Mp, Rb] one-hot
+      working buffer; must sit under the 16 MB arena;
+    * ``hbm_node_table_bytes`` / ``f32_node_table_bytes`` — what the
+      resident SoA costs, and how much of it is f32 node data.  For
+      int8/bf16 the second number is ZERO — the r18 acceptance that no
+      dequantized node table ever lands in HBM (the per-tree f32 scale
+      sidecar is charged separately);
+    * ``hbm_bytes_per_row`` vs ``r14_hbm_bytes_per_row`` — per-row HBM
+      traffic with the table amortized over the bucket; the r14 path
+      streamed a widened 21 B/node f32/i32 table regardless of the
+      stored precision.
+    """
+    from ..ops.predict import PREDICT_NODE_PAD, PREDICT_TREE_CHUNKS
+
+    if precision not in PREDICT_SOA_NODE_BYTES:
+        raise ValueError(f"precision must be one of "
+                         f"{tuple(PREDICT_SOA_NODE_BYTES)}, "
+                         f"got {precision!r}")
+    chunk = PREDICT_TREE_CHUNKS[precision]
+    tp = max(chunk, -(-num_trees // chunk) * chunk)
+    mp = max(PREDICT_NODE_PAD,
+             -(-node_slots // PREDICT_NODE_PAD) * PREDICT_NODE_PAD)
+    fp = max(8, -(-num_features // 8) * 8)
+    rb = 128
+
+    # launches per dispatch: fused = prologue fusions + 1 custom-call per
+    # class; r14 = the step fusion group x depth_cap + epilogue, per class
+    launches_fused = R14_PREDICT_STEP_FUSIONS + num_class
+    launches_r14 = num_class * (R14_PREDICT_STEP_FUSIONS * depth_cap
+                                + R14_PREDICT_EPILOGUE_FUSIONS)
+
+    # VMEM of one grid step (all tiles widened to f32 in-kernel)
+    onehot = chunk * mp * rb * 4            # [Tc, Mp, Rb] working buffer
+    tables = 5 * chunk * mp * 4             # feat/thr/left/right/leaf
+    bins_blk = fp * rb * 4
+    vmem = onehot + tables + bins_blk + chunk * 4 + rb * 4
+
+    node_b = PREDICT_SOA_NODE_BYTES[precision]
+    table_bytes = num_class * tp * mp * node_b
+    scale_bytes = num_class * tp * 4
+    f32_table = table_bytes if precision == "f32" else 0
+    per_row = num_features * 4 + (table_bytes + scale_bytes) / bucket
+    r14_per_row = (num_features * 4
+                   + num_class * num_trees * node_slots * 21 / bucket)
+    return {
+        "launches_fused": launches_fused,
+        "launches_r14_model": launches_r14,
+        "launch_drop_x": launches_r14 / launches_fused,
+        "vmem_block_bytes": vmem,
+        "vmem_block_mb": vmem / 2**20,
+        "hbm_node_table_bytes": table_bytes,
+        "hbm_scale_bytes": scale_bytes,
+        "f32_node_table_bytes": f32_table,
+        "hbm_bytes_per_row": per_row,
+        "r14_hbm_bytes_per_row": r14_per_row,
+        "bytes_per_row_drop_x": r14_per_row / per_row,
+    }
+
+
+def predict_kernels_summary(bucket: int = 8) -> Dict[str, object]:
+    """The r18 bench-artifact dict: fused predict launch counts, CPU-
+    measured plus the TPU launch model — cross-referenced against the
+    declarative budgets so BENCH_SERVE artifacts and the lint gate
+    cannot disagree (same contract as ``kernels_per_round_summary``)."""
+    cpu_f, cpu_c = serving_predict_counts(bucket)
+    xla_f, xla_c = serving_predict_counts(bucket, stub=True)
+    m = predict_kernel_time()
+    budget = budget_by_name("serving_predict_tpu_model").budget
+    return {
+        "predict_kernels_fused_cpu_inlined": cpu_f + cpu_c,
+        "predict_kernels_tpu_model": xla_f + xla_c,
+        "predict_budget_tpu_model": budget,
+        "predict_within_budget": bool(xla_f + xla_c <= budget),
+        "predict_launches_r14_model": m["launches_r14_model"],
+        "predict_launch_drop_x": round(m["launch_drop_x"], 2),
+        "predict_launch_drop_floor": 4.0,
+        "predict_drop_within_floor": bool(m["launch_drop_x"] >= 4.0),
+        "predict_vmem_block_mb": round(m["vmem_block_mb"], 2),
+        "predict_f32_node_table_bytes": m["f32_node_table_bytes"],
+        "predict_hbm_bytes_per_row": round(m["hbm_bytes_per_row"], 1),
+        "predict_r14_hbm_bytes_per_row":
+            round(m["r14_hbm_bytes_per_row"], 1),
+    }
+
+
 @dataclass(frozen=True)
 class ServeSLOBudget:
     """One serving SLO invariant at a reference operating point.
@@ -1013,7 +1201,15 @@ class ServeSLOBudget:
       route as a fraction of the per-device compute slice at
       ``mesh_devices`` (ceiling: the non-scaling part must stay small);
     * ``dp_speedup`` — r14: modeled QPS multiple of the dp route at
-      ``mesh_devices`` (floor).
+      ``mesh_devices`` (floor);
+    * ``fused_launch_drop`` — r18: TPU launches per dispatch of the r14
+      per-node path over the fused mega-kernel at the reference forest
+      shape (``predict_kernel_time``; floor: >= 4x);
+    * ``fused_vmem_mb`` — r18: peak VMEM of one fused-kernel grid step
+      at ``precision`` (ceiling: the 16 MB arena);
+    * ``fused_f32_table_bytes`` — r18: f32 node-table bytes the fused
+      path keeps resident in HBM at ``precision`` — ZERO for int8/bf16
+      (the no-dequantize-pass acceptance).
 
     ``cmp`` is "le" (measured <= budget passes) or "ge".
     Reference point: 2 ms dispatches, 128-row batches, 5 ms coalescing
@@ -1058,6 +1254,15 @@ class ServeSLOBudget:
         if self.kind == "dp_speedup":
             return serve_mesh_dispatch_model(
                 self.mesh_devices, self.dispatch_ms)["speedup_x"]
+        if self.kind == "fused_launch_drop":
+            return predict_kernel_time(
+                precision=self.precision)["launch_drop_x"]
+        if self.kind == "fused_vmem_mb":
+            return predict_kernel_time(
+                precision=self.precision)["vmem_block_mb"]
+        if self.kind == "fused_f32_table_bytes":
+            return float(predict_kernel_time(
+                precision=self.precision)["f32_node_table_bytes"])
         raise ValueError(f"unknown SLO budget kind {self.kind!r}")
 
     def check(self) -> Dict[str, object]:
@@ -1107,6 +1312,29 @@ SERVE_SLO_BUDGETS: Tuple[ServeSLOBudget, ...] = (
                    note="r14 acceptance: dp route delivers >=3x QPS at "
                         "D=4 under the dispatch model (near-linear "
                         "minus the fixed launch/gather cost)"),
+    # -- r18 fused-predict entries --------------------------------------------
+    ServeSLOBudget("serve_fused_launch_drop", "fused_launch_drop", 4.0,
+                   cmp="ge", precision="int8",
+                   note="r18 acceptance: fused mega-kernel cuts TPU "
+                        "launches per dispatch >=4x vs the r14 per-node "
+                        "path at the reference forest (depth runs "
+                        "inside the kernel, launches stop scaling with "
+                        "depth_cap)"),
+    ServeSLOBudget("serve_fused_vmem_int8", "fused_vmem_mb", 16.0,
+                   precision="int8",
+                   note="one fused grid step (widened tiles + one-hot "
+                        "working buffer) fits the 16 MB VMEM arena at "
+                        "the int8 reference shape (~8.3 MB modeled)"),
+    ServeSLOBudget("serve_fused_no_f32_table_int8",
+                   "fused_f32_table_bytes", 0.0, precision="int8",
+                   note="r18 acceptance: int8 residency keeps ZERO f32 "
+                        "node-table bytes in HBM — the SoA ships the "
+                        "stored i16/u8/i8 arrays, dequant is one "
+                        "per-tree scale inside the kernel"),
+    ServeSLOBudget("serve_fused_no_f32_table_bf16",
+                   "fused_f32_table_bytes", 0.0, precision="bf16",
+                   note="bf16 residency likewise keeps no f32 node "
+                        "table resident"),
 )
 
 
@@ -1667,6 +1895,15 @@ BUDGET_ANCHORS: Dict[str, Tuple[Tuple[str, str], ...]] = {
         ("lightgbm_tpu/ops/quantize.py", "wire_transfer"),
         ("lightgbm_tpu/ops/quantize.py", "models_per_byte_gain"),
         ("lightgbm_tpu/ops/quantize.py", "packed_model_bytes"),
+    ),
+    "predict": (
+        # r18 fused predict: the SoA layout, the packer, the mega-kernel
+        # entry point, and the tp shard wrapper the launch/VMEM/HBM
+        # models (predict_kernel_time) and launch budgets lower or model
+        ("lightgbm_tpu/ops/predict.py", "ForestSoA"),
+        ("lightgbm_tpu/ops/predict.py", "pack_forest_soa"),
+        ("lightgbm_tpu/ops/predict.py", "predict_forest_pallas"),
+        ("lightgbm_tpu/serving/mesh.py", "tp_raw_margins_fused"),
     ),
     "ckpt": (
         ("lightgbm_tpu/training/checkpoint.py", "save_checkpoint"),

@@ -17,7 +17,8 @@ from .split import (
     leaf_output,
     threshold_l1,
 )
-from .predict import predict_forest_binned, predict_tree_binned
+from .predict import (ForestSoA, pack_forest_soa, predict_forest_binned,
+                      predict_forest_pallas, predict_tree_binned)
 
 __all__ = [
     "compute_histograms",
@@ -34,6 +35,9 @@ __all__ = [
     "leaf_objective",
     "leaf_output",
     "threshold_l1",
+    "ForestSoA",
+    "pack_forest_soa",
     "predict_forest_binned",
+    "predict_forest_pallas",
     "predict_tree_binned",
 ]

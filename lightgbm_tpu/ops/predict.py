@@ -14,9 +14,23 @@ the [chunk, n] node state stays bounded for million-row batches, and a
 traced round mask gives staged prediction (``ntree_limit``/``num_iteration``
 truncation — the xgb staged-predict contract of bagging_boosting.ipynb:136,
 SURVEY.md §3.4) with no recompilation.
+
+r18 gives the SERVING hot path its own mega-kernel (ROADMAP item 3, the
+r7 treatment): :func:`predict_forest_pallas` fuses level-synchronous
+traversal of every tree with leaf-value accumulation into ONE Pallas
+kernel over :class:`ForestSoA` — depth-major SoA node tables padded to
+(sublane, 128)-lane tiles that keep the COMPACT quantized dtypes
+resident (uint8 thresholds, int16 indices, int8/bf16 leaves; no
+dequantize pass, no f32 node table in HBM).  Thresholds compare as the
+stored bin codes; the per-tree dequant scale folds into the traced
+round mask so leaf contributions accumulate in f32 with the scale
+applied once per tree.  The chunked scan path above remains the
+training-side predictor and the semantics oracle.
 """
 
 from __future__ import annotations
+
+from typing import NamedTuple, Optional
 
 import numpy as np
 import jax
@@ -24,6 +38,303 @@ import jax.numpy as jnp
 from jax import lax
 
 DEFAULT_TREE_CHUNK = 32
+
+# --- fused predict mega-kernel (r18) constants ------------------------------
+# Node slots pad to a full lane so every one-hot contraction runs on
+# (sublane, 128)-aligned tiles; rows ride the 128-lane minor axis.
+PREDICT_NODE_PAD = 128
+PREDICT_ROW_BLOCK = 128
+# Tree-chunk (sublane) grouping per precision: the compact dtypes set the
+# minimum legal sublane tile — uint8 thresholds / int8 leaves need 32,
+# an all-i32/f32 forest needs only 8 (pallas_guide.md tiling table).
+PREDICT_TREE_CHUNKS = {"f32": 8, "bf16": 32, "int8": 32}
+
+# tools/hlo_counts.py + analysis.budgets flip this to compile the serving
+# predict program with the mega-kernel replaced by a pure_callback, so a
+# CPU HLO shows the same launch structure a TPU build has — XLA-side
+# fusions plus ONE custom-call per class (interpret mode would inline the
+# kernel instead).  Never set in production.
+_PREDICT_OPCOUNT_STUB = False
+
+
+class ForestSoA(NamedTuple):
+    """Depth-major SoA node tables — the fused kernel's residency format.
+
+    All arrays carry a leading padded tree axis ``Tp`` (multiple of the
+    precision's sublane chunk) and a node axis ``Mp`` (multiple of 128
+    lanes).  Dtypes are the COMPACT storage dtypes of the quantized
+    layout contract (``ops.quantize.PACKED_NODE_BYTES``): these buffers
+    are what stays resident in HBM; the kernel widens per-block tiles to
+    f32 transiently in VMEM.  Leaves and dead slots self-loop
+    (``left == right == self``), so traversal needs no ``is_leaf``
+    lookup — the array is kept purely as the residency-parity byte of
+    the layout contract and for host-side audits.
+    """
+
+    split_feature: jnp.ndarray   # [Tp, Mp] i16 (quantized) / i32 (f32)
+    split_bin: jnp.ndarray       # [Tp, Mp] u8 (quantized) / i32 (f32)
+    left: jnp.ndarray            # [Tp, Mp] i16 / i32 — self-loop at leaves
+    right: jnp.ndarray           # [Tp, Mp] i16 / i32 — self-loop at leaves
+    leaf: jnp.ndarray            # [Tp, Mp] i8 / bf16 / f32 quantized leaves
+    is_leaf: jnp.ndarray         # [Tp, Mp] bool (residency parity only)
+    scale: jnp.ndarray           # [Tp] f32 per-tree dequant scale (1.0s
+    #                              for f32/bf16 — applied once at the end)
+
+
+def soa_tree_chunk(soa: ForestSoA) -> int:
+    """Sublane tree-chunk this SoA's dtypes require (8 or 32)."""
+    narrow = min(soa.split_bin.dtype.itemsize, soa.leaf.dtype.itemsize)
+    return 8 if narrow >= 4 else 32
+
+
+def _depth_major_order(left_t: np.ndarray, right_t: np.ndarray,
+                       is_leaf_t: np.ndarray) -> np.ndarray:
+    """BFS node permutation for one tree: every level's nodes contiguous
+    (depth-major), unreachable slots appended last.  Terminates for any
+    input because each frontier only admits unseen nodes."""
+    m = left_t.shape[0]
+    seen = np.zeros(m, bool)
+    seen[0] = True
+    frontier = np.array([0], np.int64)
+    levels = []
+    while frontier.size:
+        levels.append(frontier)
+        internal = frontier[~is_leaf_t[frontier]]
+        kids = np.concatenate([left_t[internal], right_t[internal]])
+        kids = np.unique(kids[(kids >= 0) & (kids < m)])
+        kids = kids[~seen[kids]]
+        seen[kids] = True
+        frontier = kids
+    dead = np.flatnonzero(~seen)
+    return np.concatenate(levels + [dead]).astype(np.int64)
+
+
+def pack_forest_soa(split_feature, split_bin, left, right, leaf_value,
+                    is_leaf, *, precision: str = "f32",
+                    leaf_scale=None, node_pad: int = PREDICT_NODE_PAD,
+                    tree_multiple: Optional[int] = None) -> ForestSoA:
+    """Host-side layout specialization: per-node arrays -> ForestSoA.
+
+    Reorders every tree depth-major (BFS), folds leaves and dead slots
+    into self-loops, pads nodes to a 128-lane multiple and trees to the
+    precision's sublane chunk, and PRESERVES the compact storage dtypes
+    — for int8/bf16 forests no f32 (or even i32) node table is ever
+    built; the quantized arrays go to the device as stored.  Thresholds
+    stay the exact uint8 bin codes (``ops.quantize`` already refused any
+    forest where they would not fit exactly), so the kernel's
+    ``code <= threshold`` comparison in f32 lanes is the SAME integer
+    comparison the f32 path makes: quantized-space routing is exact, not
+    a tolerance (PARITY.md).
+
+    Args are host numpy arrays shaped ``[T, M]`` (one class);
+    ``leaf_value`` is the precision's storage representation (i8 codes
+    for int8, bf16-rounded values for bf16, plain f32 otherwise) and
+    ``leaf_scale`` the int8 per-tree dequant scale.
+    """
+    if precision not in PREDICT_TREE_CHUNKS:
+        raise ValueError(f"precision must be one of "
+                         f"{tuple(PREDICT_TREE_CHUNKS)}, got {precision!r}")
+    feat = np.asarray(split_feature)
+    thr = np.asarray(split_bin)
+    left = np.asarray(left)
+    right = np.asarray(right)
+    leaf = np.asarray(leaf_value)
+    is_leaf = np.asarray(is_leaf, bool)
+    t, m = feat.shape
+    if t and m > (1 << 24):
+        raise ValueError("node capacity exceeds the f32-exact integer "
+                         "range the one-hot gathers rely on")
+
+    mp = max(node_pad, -(-m // node_pad) * node_pad)
+    chunk = PREDICT_TREE_CHUNKS[precision]
+    if tree_multiple is not None:
+        chunk = max(chunk, int(tree_multiple))
+    tp = max(chunk, -(-t // chunk) * chunk)
+
+    if precision == "f32":
+        idx_t, thr_t, leaf_t = np.int32, np.int32, np.float32
+    else:
+        idx_t, thr_t = np.int16, np.uint8
+        leaf_t = np.int8 if precision == "int8" else np.float32
+
+    self_loop = np.arange(mp)
+    o_feat = np.zeros((tp, mp), idx_t)
+    o_thr = np.zeros((tp, mp), thr_t)
+    o_left = np.broadcast_to(self_loop, (tp, mp)).astype(idx_t)
+    o_right = o_left.copy()
+    o_left = o_left.copy()
+    o_leaf = np.zeros((tp, mp), leaf_t)
+    o_isleaf = np.ones((tp, mp), bool)
+
+    for ti in range(t):
+        perm = _depth_major_order(left[ti], right[ti], is_leaf[ti])
+        inv = np.empty(m, np.int64)
+        inv[perm] = np.arange(m)
+        lf, at_leaf = leaf[ti][perm], is_leaf[ti][perm]
+        l_old, r_old = left[ti][perm], right[ti][perm]
+        internal = ~at_leaf & (l_old >= 0) & (r_old >= 0)
+        new_i = np.arange(m)
+        o_feat[ti, :m] = np.where(internal, feat[ti][perm], 0)
+        o_thr[ti, :m] = np.where(internal, thr[ti][perm], 0)
+        o_left[ti, :m] = np.where(internal, inv[np.clip(l_old, 0, m - 1)],
+                                  new_i)
+        o_right[ti, :m] = np.where(internal, inv[np.clip(r_old, 0, m - 1)],
+                                   new_i)
+        # dead slots are self-loops with a zero leaf — grower sentinels
+        # in unreachable slots must never leak into the leaf table
+        o_leaf[ti, :m] = np.where(at_leaf, lf, 0)
+        o_isleaf[ti, :m] = ~internal
+
+    scale = np.ones(tp, np.float32)
+    if leaf_scale is not None:
+        scale[:t] = np.asarray(leaf_scale, np.float32)
+
+    leaf_dev = (jnp.asarray(o_leaf, jnp.bfloat16) if precision == "bf16"
+                else jnp.asarray(o_leaf))
+    return ForestSoA(
+        split_feature=jnp.asarray(o_feat), split_bin=jnp.asarray(o_thr),
+        left=jnp.asarray(o_left), right=jnp.asarray(o_right),
+        leaf=leaf_dev, is_leaf=jnp.asarray(o_isleaf),
+        scale=jnp.asarray(scale))
+
+
+def _forest_kernel(bins_ref, feat_ref, thr_ref, left_ref, right_ref,
+                   leaf_ref, sm_ref, out_ref, *, depth_cap: int):
+    """One (row-block, tree-chunk) grid step of the fused mega-kernel.
+
+    Level-synchronous traversal: every row advances one level per
+    iteration across the whole tree chunk at once; leaves self-loop so
+    after ``depth_cap`` steps every lane sits on its leaf.  All gathers
+    are one-hot contractions over exact small integers held in f32
+    lanes (the repo's histogram-kernel idiom — TPU has no VMEM gather),
+    so routing is exact; only the leaf-value accumulation is real f32
+    arithmetic.  The tree-chunk grid axis revisits the output block and
+    accumulates (``@pl.when`` zero-init on the first chunk)."""
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bins = bins_ref[:]                            # [Fp, R] f32 bin codes
+    feat = feat_ref[:].astype(jnp.float32)        # [Tc, Mp]
+    thr = thr_ref[:].astype(jnp.float32)
+    left = left_ref[:].astype(jnp.float32)
+    right = right_ref[:].astype(jnp.float32)
+    leaf = leaf_ref[:].astype(jnp.float32)        # quantized codes/values
+    sm = sm_ref[:]                                # [Tc, 1] scale * round-mask
+    tc, mp = feat.shape
+    fp, r = bins.shape
+
+    iota_m = lax.broadcasted_iota(jnp.int32, (tc, mp, r), 1)
+    iota_f = lax.broadcasted_iota(jnp.float32, (tc, fp, r), 1)
+
+    def onehot(node):                             # [Tc, R] i32 -> f32 3-D
+        return (node[:, None, :] == iota_m).astype(jnp.float32)
+
+    def gather(oh, tbl):                          # -> [Tc, R]
+        return jnp.sum(oh * tbl[:, :, None], axis=1)
+
+    def step(_, node):
+        oh = onehot(node)
+        f_g = gather(oh, feat)
+        t_g = gather(oh, thr)
+        l_g = gather(oh, left)
+        r_g = gather(oh, right)
+        code = jnp.sum((f_g[:, None, :] == iota_f).astype(jnp.float32)
+                       * bins[None, :, :], axis=1)
+        # quantized-space routing: code and threshold are both exact
+        # integers in f32 lanes, so <= is the stored-bin comparison
+        nxt = jnp.where(code <= t_g, l_g, r_g)
+        return nxt.astype(jnp.int32)
+
+    node = lax.fori_loop(0, depth_cap, step,
+                         jnp.zeros((tc, r), jnp.int32))
+    lv = gather(onehot(node), leaf)               # [Tc, R]
+    out_ref[...] += jnp.sum(lv * sm, axis=0)[None, :]
+
+
+def predict_forest_pallas(
+    soa: ForestSoA,
+    bins: jnp.ndarray,
+    learning_rate,
+    init_score,
+    num_iteration: jnp.ndarray,
+    depth_cap: int,
+    start_iteration: jnp.ndarray = 0,
+    row_block: int = PREDICT_ROW_BLOCK,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused forest predict: ONE Pallas kernel launch per forest.
+
+    Replaces the chunked scan-of-scans device path (``T/chunk *
+    depth_cap`` skinny launches) with a single kernel whose grid tiles
+    (row-block x tree-chunk); traversal + leaf accumulation fuse, the
+    quantized node tables are read directly in storage dtype, and the
+    per-tree dequant scale folds into the traced round mask so it is
+    applied exactly once per tree at the end.  The staged-prediction
+    contract holds: ``num_iteration``/``start_iteration`` are traced
+    operands of the scale*mask vector, never compile-time constants.
+
+    Returns ``init_score + learning_rate * sum(masked leaf values)`` as
+    f32 ``[n]`` — same contract as :func:`predict_forest_binned`.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    import functools
+
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    n, f = bins.shape
+    tp, mp = soa.split_feature.shape
+    tc = soa_tree_chunk(soa)
+    if tp % tc:
+        raise ValueError(f"SoA tree axis {tp} is not a multiple of its "
+                         f"sublane chunk {tc} — use pack_forest_soa")
+    n_tc = tp // tc
+    rb = row_block          # static python int — part of the compile key
+    n_pad = max(rb, -(-n // rb) * rb)
+    n_rb = n_pad // rb
+    fp = max(8, -(-f // 8) * 8)
+
+    # rows ride the 128-lane minor axis: [Fp, n_pad] f32 (bin codes are
+    # exact small integers; padded rows traverse on zero codes and are
+    # sliced off, padded features are never referenced)
+    bins_t = jnp.pad(bins.astype(jnp.float32).T,
+                     ((0, fp - f), (0, n_pad - n)))
+    start = jnp.asarray(start_iteration, jnp.int32)
+    num_it = jnp.asarray(num_iteration, jnp.int32)
+    t_idx = jnp.arange(tp, dtype=jnp.int32)
+    use = (t_idx >= start) & (t_idx < start + num_it)
+    sm = (use.astype(jnp.float32) * soa.scale)[:, None]     # [Tp, 1]
+
+    if _PREDICT_OPCOUNT_STUB:
+        # op-count probe: swap the kernel for a pure_callback so a CPU
+        # compile shows the TPU launch structure (one custom-call per
+        # forest).  Compile-only; never executed.
+        out = jax.pure_callback(
+            lambda b, s: np.zeros((1, b.shape[1]), np.float32),
+            jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+            bins_t, sm, vmap_method="legacy_vectorized")
+    else:
+        kernel = functools.partial(_forest_kernel, depth_cap=depth_cap)
+        tbl_spec = pl.BlockSpec((tc, mp), lambda r_, c: (c, 0))
+        out = pl.pallas_call(
+            kernel,
+            grid=(n_rb, n_tc),
+            in_specs=[
+                pl.BlockSpec((fp, rb), lambda r_, c: (0, r_)),
+                tbl_spec, tbl_spec, tbl_spec, tbl_spec, tbl_spec,
+                pl.BlockSpec((tc, 1), lambda r_, c: (c, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, rb), lambda r_, c: (0, r_)),
+            out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+            interpret=interpret,
+        )(bins_t, soa.split_feature, soa.split_bin, soa.left,
+          soa.right, soa.leaf, sm)
+
+    return init_score + learning_rate * out[0, :n]
 
 
 def predict_tree_binned(tree, bins: jnp.ndarray,

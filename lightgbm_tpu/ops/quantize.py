@@ -147,12 +147,34 @@ class QuantizedForestArrays:
     cat_mask: Optional[np.ndarray] = None
 
     def dequantized_leaf_values(self) -> np.ndarray:
-        """f32 leaf values as the DEVICE will see them — the numpy-oracle
-        side of the serving canary's device-vs-oracle drift gate."""
+        """f32 leaf values as the DEVICE arithmetic resolves them — the
+        numpy-ORACLE side of the serving canary's device-vs-oracle drift
+        gate, and nothing else.  r18 demoted this from the device build
+        path: the fused predict kernel reads ``leaf_q`` directly in
+        storage dtype and applies ``leaf_scale`` once per tree inside
+        the kernel, so this f32 table exists only inside the lazily
+        built numpy oracle (``PredictorRuntime.oracle``), never in
+        device HBM."""
         if self.precision == "int8":
             return (self.leaf_q.astype(np.float32)
                     * self.leaf_scale[..., None])
         return np.asarray(self.leaf_q, np.float32)
+
+    def class_arrays(self, c: Optional[int] = None) -> tuple:
+        """Compact traversal arrays for one class, in storage dtypes —
+        the plumbing between the quantizer and the fused kernel's
+        ``ops.predict.pack_forest_soa`` (which keeps these dtypes
+        resident; no widening, no dequantize pass).  ``c=None`` returns
+        the binary/regression ``[T, M]`` arrays unchanged; an int
+        selects the class plane of ``[T, K, M]`` multiclass arrays.
+        Returns ``(split_feature, split_bin, left, right, leaf_q,
+        is_leaf, leaf_scale)``."""
+        pick = (lambda a: a) if c is None else (lambda a: a[:, c])
+        return (pick(self.split_feature), pick(self.split_bin),
+                pick(self.left), pick(self.right), pick(self.leaf_q),
+                pick(self.is_leaf),
+                None if self.leaf_scale is None
+                else pick(self.leaf_scale))
 
     def node_bytes(self) -> int:
         """Resident traversal bytes (node arrays + scale sidecar)."""
@@ -282,6 +304,11 @@ def to_device_tree(q: QuantizedForestArrays) -> Tuple[object, object]:
     — these are the buffers that stay resident in HBM between requests;
     the serving runtime widens them inside each compiled program, so
     dispatch arithmetic is f32 while residency is quantized.
+
+    r18: this is now the LEGACY device layout, used only where the fused
+    SoA kernel does not engage (categorical forests).  The default path
+    packs ``class_arrays`` through ``ops.predict.pack_forest_soa``,
+    which never widens — not even transiently per dispatch.
     """
     import jax.numpy as jnp
     from ..models.tree import Tree

@@ -128,7 +128,7 @@ def choose_route(policy: str, bucket: int, num_trees: int,
     return "single"
 
 
-def dp_shard(smesh: ServingMesh, fn):
+def dp_shard(smesh: ServingMesh, fn, check_vma: bool = True):
     """Row-shard a single-device predict program ``fn(bins, mask,
     num_it)`` across the mesh.
 
@@ -139,6 +139,11 @@ def dp_shard(smesh: ServingMesh, fn):
     transform are all row-elementwise), so each row's result is computed
     by the identical instruction sequence the single-device program
     runs: bit-identity at f32 is by construction, not by tolerance.
+
+    ``check_vma=False`` is required when the body contains a
+    ``pallas_call`` (the fused r18 path): shard_map's replication
+    checker has no rule for custom kernels.  The contract is unchanged
+    — the kernel body is still row-elementwise per shard.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -147,7 +152,7 @@ def dp_shard(smesh: ServingMesh, fn):
     ax = smesh.axis_name
     return shard_map(fn, smesh.mesh,
                      in_specs=(P(ax), P(ax), P()),
-                     out_specs=P(ax))
+                     out_specs=P(ax), check_vma=check_vma)
 
 
 def pad_forest_for_tp(forest, leaf_scale, n_devices: int):
@@ -175,6 +180,85 @@ def pad_forest_for_tp(forest, leaf_scale, n_devices: int):
                  jnp.ones((pad,) + leaf_scale.shape[1:],
                           leaf_scale.dtype)])
     return forest, leaf_scale, t_pad // n_devices
+
+
+def pad_soa_for_tp(soa, n_devices: int):
+    """Pad a ``ForestSoA``'s tree axis for tree-parallel sharding.
+
+    The target is a multiple of (sublane chunk x devices): each shard's
+    slice must itself be a legal fused-kernel operand, so trees pad to
+    ``lcm(chunk, chunk * D) = chunk * D``.  Padded trees are inert
+    exactly like the packer's own padding — every node self-loops as a
+    zero leaf, scale pads with 1.0, and the traced round mask excludes
+    their global indices anyway.  Returns ``(soa, trees_per_device)``.
+    """
+    import jax.numpy as jnp
+
+    from ..ops.predict import soa_tree_chunk
+
+    t, m = soa.split_feature.shape
+    mult = soa_tree_chunk(soa) * n_devices
+    t_pad = -(-t // mult) * mult
+    pad = t_pad - t
+    if pad:
+        self_loop = jnp.broadcast_to(jnp.arange(m), (pad, m))
+
+        def pad_field(a, name):
+            if name == "scale":
+                return jnp.concatenate([a, jnp.ones(pad, a.dtype)])
+            if name in ("left", "right"):
+                return jnp.concatenate([a, self_loop.astype(a.dtype)])
+            if name == "is_leaf":
+                return jnp.concatenate(
+                    [a, jnp.ones((pad, m), a.dtype)])
+            return jnp.concatenate(
+                [a, jnp.zeros((pad, m), a.dtype)])
+
+        soa = type(soa)(*(pad_field(a, name) for name, a
+                          in zip(soa._fields, soa)))
+    return soa, t_pad // n_devices
+
+
+def tp_raw_margins_fused(smesh: ServingMesh, soas, trees_per_device: int,
+                         shrink, depth_cap: int, num_class: int = 1):
+    """Fused-path tree-parallel raw margins: shard every per-class
+    ``ForestSoA`` on its tree axis, run the mega-kernel per shard, and
+    ``psum`` the per-shard raw sums.
+
+    Same contract as :func:`tp_raw_margins` (replicated ``[n]`` /
+    ``[n, K]`` output without init_score; traced global truncation
+    window mapped into local tree coordinates via ``start_iteration =
+    -axis_index * trees_per_device``), but each shard traverses its
+    quantized SoA slice directly — no widening, per-shard scale folded
+    into the kernel's round mask.  ``soas`` must already be padded with
+    :func:`pad_soa_for_tp`.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.predict import predict_forest_pallas
+    from ..utils.compat import shard_map
+
+    ax = smesh.axis_name
+
+    def body(soas_loc, bins, num_it):
+        offset = lax.axis_index(ax) * trees_per_device
+        start = -jnp.asarray(offset, jnp.int32)
+        cols = [predict_forest_pallas(
+            soas_loc[c], bins, shrink, 0.0, num_it, depth_cap,
+            start_iteration=start) for c in range(num_class)]
+        local = jnp.stack(cols, axis=1) if num_class > 1 else cols[0]
+        return lax.psum(local, ax)
+
+    sharded = shard_map(body, smesh.mesh,
+                        in_specs=(P(ax), P(), P()),
+                        out_specs=P(), check_vma=False)
+
+    def fn(bins, num_it):
+        return sharded(soas, bins, num_it)
+
+    return fn
 
 
 def tp_raw_margins(smesh: ServingMesh, forest, leaf_scale,

@@ -348,7 +348,10 @@ class MicroBatcher:
         Uses the runtime's ``oracle`` forest (dequantized leaf values
         for int8/bf16 runtimes), so degraded-mode answers match what the
         device would have produced instead of silently reverting to the
-        exact f32 model mid-incident.
+        exact f32 model mid-incident.  ``oracle`` is a lazily built,
+        cached property (r18): the f32 leaf table materializes on the
+        FIRST fallback (or canary) and only then — swaps that never
+        degrade never pay it.
         """
         if not self.fallback_unbatched:
             for r in group:

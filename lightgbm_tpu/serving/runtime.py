@@ -28,22 +28,37 @@ r14 adds the pod-scale knobs (see :mod:`serving.mesh` and
   chosen route per bucket, so sharded traffic pays zero traffic-path
   compiles after a warm deploy.
 * ``forest_precision`` — keep the resident forest quantized (int8/bf16
-  leaf values with per-tree scales, uint8 thresholds, int16 indices) and
-  widen INSIDE each compiled program: dispatch arithmetic stays f32
-  while HBM residency shrinks ~2.3x (int8).  ``runtime.oracle`` is a
-  PackedForest carrying the DEQUANTIZED leaf values — the numpy
-  reference for the canary and the queue's fallback path, so
-  device-vs-oracle stays tight at any precision — and
+  leaf values with per-tree scales, uint8 thresholds, int16 indices).
+  ``runtime.oracle`` is a PackedForest carrying the DEQUANTIZED leaf
+  values — the numpy reference for the canary and the queue's fallback
+  path, so device-vs-oracle stays tight at any precision — and
   ``quant_error_bound`` is the worst-case |quantized - exact| served
   margin (arithmetic from ``ops.quantize``, not an estimate).
 
+r18 makes the FUSED mega-kernel the default device path (ROADMAP item
+3): every non-categorical forest packs into per-class
+``ops.predict.ForestSoA`` tables — depth-major, lane-padded, in the
+COMPACT storage dtypes — and every bucket program is one
+``predict_forest_pallas`` launch per class instead of the chunked
+scan-of-scans.  Quantized forests are traversed directly in quantized
+space: thresholds compare as stored uint8 bin codes and the per-tree
+scale folds into the traced round mask, so no f32 (or i32) node table
+is ever materialized in HBM — not resident, not transiently per
+dispatch.  The oracle's f32 leaf table is built LAZILY on first
+canary/fallback access and cached, never eagerly at ingest.
+Categorical forests keep the legacy widen-in-program path
+(``fused_predict`` is False there) with identical external semantics.
+
 Per-bucket counters (requests, dispatches, cache hits/misses, padding
-waste, latency quantiles) land in :class:`serving.stats.ServingStats`.
+waste, latency quantiles) land in :class:`serving.stats.ServingStats`,
+which r18 extends with live ``predict_kernel_launches`` / ``fused_path``
+counters.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from collections import OrderedDict
 from typing import Optional
@@ -151,36 +166,109 @@ class PredictorRuntime:
                         if donate is None else bool(donate))
         self.mesh = (ServingMesh(mesh_devices) if int(mesh_devices) > 1
                      else None)
+        # r18: the fused SoA mega-kernel is the default device path;
+        # categorical subset splits keep the legacy chunked-scan path
+        # (the SoA traversal has no cat-mask lane yet)
+        self.fused_predict = packed.is_cat_split is None
+        self._q = None
         if forest_precision == "f32":
-            self._forest = packed.to_tree()       # device-resident once
-            self._leaf_scale = None
             self.quant_error_bound = 0.0
-            self.oracle = packed        # fallback/canary numpy reference
         else:
-            q = quantize_forest(
+            self._q = quantize_forest(
                 packed.split_feature, packed.split_bin, packed.left,
                 packed.right, packed.leaf_value, packed.is_leaf,
                 forest_precision, is_cat_split=packed.is_cat_split,
                 cat_mask=packed.cat_mask)
-            self._forest, self._leaf_scale = to_device_tree(q)
             # served margins scale the raw tree sum by shrink; multiply
             # the raw bound through so callers compare against outputs
-            self.quant_error_bound = q.error_bound * abs(packed.shrink)
-            self.oracle = dataclasses.replace(
-                packed, leaf_value=q.dequantized_leaf_values())
+            self.quant_error_bound = (self._q.error_bound
+                                      * abs(packed.shrink))
+        # the numpy oracle (and its f32 leaf table, for quantized
+        # forests) is built lazily on first canary/fallback access —
+        # never eagerly at ingest, never rebuilt per swap
+        self._oracle = None
+        self._oracle_lock = threading.Lock()
+        self._forest = None
+        self._leaf_scale = None
+        self._soa = None                # per-class ForestSoA (fused path)
+        if self.fused_predict:
+            self._soa = self._build_soa()
+        elif forest_precision == "f32":
+            self._forest = packed.to_tree()       # device-resident once
+        else:
+            self._forest, self._leaf_scale = to_device_tree(self._q)
         self.forest_nbytes = packed_model_bytes(
             packed.num_trees, packed.capacity, packed.num_class,
             forest_precision)
+        # mega-kernel launches one compiled dispatch costs (per class;
+        # 0 on the legacy path) — mirrored into every record_dispatch
+        self.kernel_launches_per_dispatch = (
+            packed.num_class if self.fused_predict else 0)
         self._tp_padded = None          # lazily built (forest, scale, t/D)
+        self._tp_soa = None             # lazily built ([soa/class], t/D)
         self._obj = packed._objective()
         self._cache: "OrderedDict[tuple, object]" = OrderedDict()
         self.num_compiles = 0                      # lifetime program builds
         self.warmed_buckets = 0                    # precompiled via warm()
+        self.warmed_keys: set = set()   # full (bucket, raw, route) keys
         self.buckets = [1 << i
                         for i in range(self.max_bucket.bit_length())]
         # compile-cache counters ride along in every stats snapshot (the
         # serve CLI prints ONE dict on shutdown; tools embed the same)
         self.stats.attach_cache(self.cache_info)
+
+    @property
+    def oracle(self) -> PackedForest:
+        """Numpy reference forest for the canary gates and the queue's
+        graceful-degradation fallback.
+
+        Built LAZILY on first access and cached for the runtime's
+        lifetime: the f32 leaf table a quantized runtime's oracle
+        carries exists only here — never in device HBM (the fused
+        kernel reads the int8/bf16 arrays directly) and never eagerly
+        at ingest, so a hot swap whose canary is skipped and whose
+        fallback never fires pays zero dequantize cost (r18 satellite
+        of the quantized-space mega-kernel)."""
+        if self._oracle is None:
+            with self._oracle_lock:
+                if self._oracle is None:
+                    self._oracle = (
+                        self.packed if self._q is None
+                        else dataclasses.replace(
+                            self.packed,
+                            leaf_value=self._q.dequantized_leaf_values()))
+        return self._oracle
+
+    def _build_soa(self):
+        """Per-class ``ForestSoA`` residency tables for the fused kernel.
+
+        Quantized forests pack their COMPACT arrays straight through —
+        uint8 thresholds and int8/bf16 leaves go to the device in
+        storage dtype, per-tree scales ride as the f32 sidecar the
+        kernel folds into the round mask.  f32 forests pack i32/f32
+        (their contract dtypes)."""
+        from ..ops.predict import pack_forest_soa
+
+        p, q = self.packed, self._q
+        nc = p.num_class
+        soas = []
+        for c in range(nc):
+            ci = c if nc > 1 else None
+            if q is None:
+                pick = (lambda a: np.asarray(a)) if ci is None else (
+                    lambda a: np.asarray(a)[:, ci])
+                feat, thr = pick(p.split_feature), pick(p.split_bin)
+                left, right = pick(p.left), pick(p.right)
+                leaf, isl = (pick(p.leaf_value).astype(np.float32),
+                             pick(p.is_leaf))
+                scale = None
+            else:
+                feat, thr, left, right, leaf, isl, scale = \
+                    q.class_arrays(ci)
+            soas.append(pack_forest_soa(
+                feat, thr, left, right, leaf, isl,
+                precision=self.forest_precision, leaf_scale=scale))
+        return soas
 
     # -- public API ----------------------------------------------------------
     def predict(self, data, num_iteration: Optional[int] = None,
@@ -225,6 +313,12 @@ class PredictorRuntime:
             "shard_programs": sum(1 for k in self._cache
                                   if k[2] != "single"),
             "routes_live": sorted({k[2] for k in self._cache}),
+            # r18: which device path this runtime serves on, and what
+            # one dispatch costs in mega-kernel launches (0 = legacy)
+            "fused_path": bool(self.fused_predict),
+            "kernel_launches_per_dispatch":
+                self.kernel_launches_per_dispatch,
+            "warmed_keys": len(self.warmed_keys),
         }
 
     def route_for(self, bucket: int) -> str:
@@ -246,11 +340,17 @@ class PredictorRuntime:
         exactly the ones traffic will hit.  With a mesh active each
         bucket warms the ROUTE the deterministic chooser will dispatch
         it to (dp/tp shard programs included), so the first sharded
-        batch after a swap pays zero traffic-path compiles.  When the
-        ladder exceeds the LRU bound only the LARGEST
-        ``max_cache_entries`` buckets are warmed — warming more would
-        evict programs just built.  Returns the number of programs
-        compiled.
+        batch after a swap pays zero traffic-path compiles.  The sweep
+        is keyed on the FULL compile key ``(bucket, raw_score, route)``
+        — precision is a per-runtime constant baked into every program
+        — and the warmed key set is recorded verbatim in
+        ``warmed_keys``, so "the first quantized dp request pays no
+        traffic-path compile" is checkable (the
+        ``serving_recompile_*`` lint specs sweep exactly this
+        contract).  When the ladder exceeds the LRU bound only the
+        LARGEST ``max_cache_entries`` buckets are warmed — warming more
+        would evict programs just built.  Returns the number of
+        programs compiled.
         """
         import jax
         import jax.numpy as jnp
@@ -263,10 +363,12 @@ class PredictorRuntime:
                   else self.packed.num_feature())
         before = self.num_compiles
         for b in todo:
-            fn = self._get_fn(b, raw_score, self.route_for(b))
+            key = (b, bool(raw_score), self.route_for(b))
+            fn = self._get_fn(*key)
             jax.block_until_ready(fn(
                 jnp.zeros((b, n_cols), jnp.uint8),
                 jnp.zeros(b, jnp.float32), jnp.int32(1)))
+            self.warmed_keys.add(key)
         self.warmed_buckets += len(todo)
         return self.num_compiles - before
 
@@ -292,7 +394,9 @@ class PredictorRuntime:
                             jnp.int32(k)))
         self.stats.record_dispatch(
             bucket, rows=n, padded=pad,
-            latency_s=self.clock() - t0, route=route)
+            latency_s=self.clock() - t0, route=route,
+            kernel_launches=self.kernel_launches_per_dispatch,
+            fused=self.fused_predict)
         return out[:n]
 
     def _get_fn(self, bucket: int, raw_score: bool,
@@ -313,13 +417,26 @@ class PredictorRuntime:
 
     def _tp_parts(self):
         """Tree-axis-padded (forest, leaf_scale, trees_per_device) —
-        built once, shared by every tp bucket program."""
+        built once, shared by every tp bucket program (legacy path)."""
         if self._tp_padded is None:
             from .mesh import pad_forest_for_tp
 
             self._tp_padded = pad_forest_for_tp(
                 self._forest, self._leaf_scale, self.mesh.devices)
         return self._tp_padded
+
+    def _tp_soa_parts(self):
+        """Tree-axis-padded per-class SoAs + trees_per_device for the
+        fused tp route — built once, shared by every tp bucket program.
+        Padding goes to a multiple of (sublane chunk x devices) so each
+        shard's slice is itself a legal kernel operand."""
+        if self._tp_soa is None:
+            from .mesh import pad_soa_for_tp
+
+            padded = [pad_soa_for_tp(s, self.mesh.devices)
+                      for s in self._soa]
+            self._tp_soa = ([p[0] for p in padded], padded[0][1])
+        return self._tp_soa
 
     def _build_fn(self, raw_score: bool, route: str = "single"):
         """One jitted fixed-shape predict program.
@@ -335,18 +452,26 @@ class PredictorRuntime:
         ``dp`` wraps the IDENTICAL body in a row-sharding ``shard_map``
         (bit-identical outputs at f32); ``tp`` shards the forest's tree
         axis and ``psum``s raw margins, applying init/rf/transform/mask
-        on the replicated result.  Quantized forests widen inside the
-        program (per shard for tp), so compute is f32 while residency
-        stays compact.
+        on the replicated result.
+
+        r18: on the default fused path the body is ONE
+        ``predict_forest_pallas`` launch per class over the resident
+        SoA — quantized forests traverse in quantized space, nothing
+        widens, not even transiently.  Categorical forests fall back to
+        the legacy body, which widens inside the program (per shard for
+        tp) so compute is f32 while residency stays compact.
         """
         import jax
         import jax.numpy as jnp
-        from ..ops.predict import predict_forest_binned
+        from ..ops.predict import (predict_forest_binned,
+                                   predict_forest_pallas)
 
         packed = self.packed
         forest = self._forest
         leaf_scale = self._leaf_scale
         quantized = self.forest_precision != "f32"
+        fused = self.fused_predict
+        soas = self._soa
         obj = self._obj
         nc = packed.num_class
         shrink = jnp.float32(packed.shrink)
@@ -366,37 +491,54 @@ class PredictorRuntime:
             return out * (mask[:, None] if nc > 1 else mask)
 
         if route == "tp":
-            from .mesh import tp_raw_margins
+            if fused:
+                from .mesh import tp_raw_margins_fused
 
-            tp_forest, tp_scale, t_loc = self._tp_parts()
-            raw_fn = tp_raw_margins(
-                self.mesh, tp_forest, tp_scale, t_loc, shrink,
-                depth_cap, num_class=nc, widen=quantized)
+                tp_soas, t_loc = self._tp_soa_parts()
+                raw_fn = tp_raw_margins_fused(
+                    self.mesh, tp_soas, t_loc, shrink, depth_cap,
+                    num_class=nc)
+            else:
+                from .mesh import tp_raw_margins
+
+                tp_forest, tp_scale, t_loc = self._tp_parts()
+                raw_fn = tp_raw_margins(
+                    self.mesh, tp_forest, tp_scale, t_loc, shrink,
+                    depth_cap, num_class=nc, widen=quantized)
 
             def fn(bins, mask, num_it):
                 raw = raw_fn(bins, num_it) + (
                     inits[None, :] if nc > 1 else inits[0])
                 return finalize(raw, mask, num_it)
         else:
-            def fn(bins, mask, num_it):
-                f = widen_tree(forest, leaf_scale) if quantized \
-                    else forest
-                if nc > 1:
-                    cols = [predict_forest_binned(
-                        jax.tree.map(lambda a, c=c: a[:, c], f), bins,
-                        shrink, float(inits[c]), num_it, depth_cap)
-                        for c in range(nc)]
-                    raw = jnp.stack(cols, axis=1)                # [n, K]
-                else:
-                    raw = predict_forest_binned(
-                        f, bins, shrink, float(inits[0]), num_it,
-                        depth_cap)
-                return finalize(raw, mask, num_it)
+            if fused:
+                def fn(bins, mask, num_it):
+                    cols = [predict_forest_pallas(
+                        soas[c], bins, shrink, float(inits[c]), num_it,
+                        depth_cap) for c in range(nc)]
+                    raw = (jnp.stack(cols, axis=1) if nc > 1
+                           else cols[0])
+                    return finalize(raw, mask, num_it)
+            else:
+                def fn(bins, mask, num_it):
+                    f = widen_tree(forest, leaf_scale) if quantized \
+                        else forest
+                    if nc > 1:
+                        cols = [predict_forest_binned(
+                            jax.tree.map(lambda a, c=c: a[:, c], f),
+                            bins, shrink, float(inits[c]), num_it,
+                            depth_cap) for c in range(nc)]
+                        raw = jnp.stack(cols, axis=1)            # [n, K]
+                    else:
+                        raw = predict_forest_binned(
+                            f, bins, shrink, float(inits[0]), num_it,
+                            depth_cap)
+                    return finalize(raw, mask, num_it)
 
             if route == "dp":
                 from .mesh import dp_shard
 
-                fn = dp_shard(self.mesh, fn)
+                fn = dp_shard(self.mesh, fn, check_vma=not fused)
 
         donate = (0,) if self._donate else ()
         return jax.jit(fn, donate_argnums=donate)

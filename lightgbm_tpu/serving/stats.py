@@ -74,6 +74,12 @@ class ServingStats:
         self.sheds = 0               # admission-control Overloaded rejects
         self.fallbacks = 0           # graceful-degradation CPU predicts
         self.route_dispatches: Dict[str, int] = {}  # single/dp/tp counts
+        # r18 fused-predict counters (mirroring the r7 compile-cache
+        # counters): the loadgen bench and the SLO budgets read LIVE
+        # launch counts from here, not just the HLO model
+        self.predict_kernel_launches = 0  # mega-kernel launches (1/class)
+        self.fused_dispatches = 0    # dispatches on the fused device path
+        self.legacy_dispatches = 0   # dispatches on the chunked-scan path
         self.queue_latencies = deque(maxlen=RESERVOIR)
         self._cache_info = None      # zero-arg callable set by the runtime
 
@@ -95,7 +101,9 @@ class ServingStats:
 
     # -- runtime-side ------------------------------------------------------
     def record_dispatch(self, bucket: int, rows: int, padded: int,
-                        latency_s: float, route: str = "single") -> None:
+                        latency_s: float, route: str = "single",
+                        kernel_launches: int = 0,
+                        fused: bool = False) -> None:
         with self._lock:
             bs = self._b(bucket)
             bs.rows += rows
@@ -104,6 +112,11 @@ class ServingStats:
             bs.latencies.append(latency_s)
             self.route_dispatches[route] = \
                 self.route_dispatches.get(route, 0) + 1
+            self.predict_kernel_launches += kernel_launches
+            if fused:
+                self.fused_dispatches += 1
+            else:
+                self.legacy_dispatches += 1
 
     def record_cache(self, bucket: int, hit: bool) -> None:
         with self._lock:
@@ -145,6 +158,11 @@ class ServingStats:
                 "sheds": self.sheds,
                 "fallbacks": self.fallbacks,
                 "route_dispatches": dict(self.route_dispatches),
+                "predict_kernel_launches": self.predict_kernel_launches,
+                "fused_path": {
+                    "dispatches": self.fused_dispatches,
+                    "legacy_dispatches": self.legacy_dispatches,
+                },
                 "queue_latency_p50_ms": _ms(_quantile(self.queue_latencies,
                                                       0.50)),
                 "queue_latency_p99_ms": _ms(_quantile(self.queue_latencies,

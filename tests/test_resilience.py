@@ -679,11 +679,13 @@ def test_serve_slo_budgets_all_green_and_wired():
                                                serve_slo_budget_by_name)
 
     res = check_serve_slo_budgets()
-    assert len(res) == len(SERVE_SLO_BUDGETS) == 8
+    assert len(res) == len(SERVE_SLO_BUDGETS) == 12
     assert all(r["ok"] for r in res)
     names = {r["name"] for r in res}
     assert {"serve_shed_before_miss", "serve_fault_p99_inflation",
-            "serve_int8_models_per_byte", "serve_dp_speedup_d4"} \
+            "serve_int8_models_per_byte", "serve_dp_speedup_d4",
+            "serve_fused_launch_drop", "serve_fused_vmem_int8",
+            "serve_fused_no_f32_table_int8"} \
         <= names
     assert serve_slo_budget_by_name(
         "serve_shed_before_miss").check()["ok"]

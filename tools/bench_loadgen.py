@@ -20,7 +20,15 @@ green) and the ``acceptance_r14`` rollup the pod-scale issue gates on:
   <=1e-4 AUC drift vs f32, >=1.9x resident models per HBM byte, and a
   HARD ``SwapRejected`` on a threshold-bound violation;
 * r14 mesh resilience — the r12 hot-swap and rollback scenarios re-run
-  with the mesh active (swaps are mesh-wide atomic).
+  with the mesh active (swaps are mesh-wide atomic);
+* r18 fused predict — every scenario above now serves on the fused
+  mega-kernel device path; the ``fused_vs_r14_dispatch`` scenario quotes
+  latency-per-row and queue p99 of the fused dispatch against the r14
+  per-node dispatch model at the SAME offered load and deadline (equal
+  quality: both paths emit identical margins, gated by the quantized
+  scenario's <=1e-4 AUC drift and the hard ``ThresholdBoundError``),
+  with launch counts cross-referenced against ``LAUNCH_BUDGETS`` via
+  ``predict_kernels_summary`` and rolled up in ``acceptance_r18``.
 
 Queueing dynamics run on a SIM CLOCK for determinism: the batcher, its
 deadlines and its EWMA wait predictor all read an advancing virtual
@@ -63,7 +71,11 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import lightgbm_tpu as lgb
-from lightgbm_tpu.analysis.budgets import (check_serve_slo_budgets,
+from lightgbm_tpu.analysis.budgets import (SERVE_DISPATCH_FIXED_S,
+                                           SERVE_GATHER_BYTES_PER_S,
+                                           check_serve_slo_budgets,
+                                           predict_kernel_time,
+                                           predict_kernels_summary,
                                            serve_mesh_dispatch_model,
                                            serve_queue_model)
 from lightgbm_tpu.serving import (FaultInjector, MicroBatcher, ModelBank,
@@ -542,6 +554,95 @@ def scenario_mesh_tier(v1_path, rows, probe, dispatch_ms, baselines,
             "tiers": tiers}
 
 
+def _predict_dispatch_ms(m: dict, launches_key: str, bytes_key: str,
+                         bucket: int) -> float:
+    """Modeled TPU dispatch time: launch overhead + HBM traffic, from the
+    same LAUNCH_OVERHEAD/ICI constants the serve mesh model charges."""
+    t = (m[launches_key] * SERVE_DISPATCH_FIXED_S
+         + bucket * m[bytes_key] / SERVE_GATHER_BYTES_PER_S)
+    return t * 1e3
+
+
+def scenario_fused_vs_r14(bank, name, packed, rows):
+    """r18 tentpole gate: latency-per-row and queue p99 of the fused
+    mega-kernel dispatch vs the r14 per-node dispatch model.
+
+    Both operating points run the REAL fused serving stack on this host
+    (correctness); the sim clock charges each path's MODELED TPU
+    dispatch time — launches x the LAUNCH_OVERHEAD family constant plus
+    HBM traffic at the ICI-class rate, from ``predict_kernel_time`` at
+    THIS model's true shape (same provenance discipline as the mesh
+    tier: real programs, validated analytical timing).  Equal quality is
+    by construction — the r14 comparator is a timing counterfactual of
+    the identical margins, and the quantized scenario separately gates
+    AUC drift and threshold-bound rejection.  Both paths face the SAME
+    open-loop arrival stream and deadline; the acceptance bar is a p99
+    win at an equal (zero) deadline-miss rate."""
+    rt = bank.runtime(name)
+    info = rt.cache_info()
+    m = predict_kernel_time(
+        num_trees=packed.num_trees,
+        node_slots=int(packed.split_feature.shape[1]),
+        depth_cap=int(packed.depth_cap),
+        num_class=int(packed.num_class),
+        precision=info["forest_precision"],
+        bucket=MAX_BUCKET,
+        num_features=packed.num_feature())
+    fused_ms = _predict_dispatch_ms(m, "launches_fused",
+                                    "hbm_bytes_per_row", MAX_BUCKET)
+    r14_ms = _predict_dispatch_ms(m, "launches_r14_model",
+                                  "r14_hbm_bytes_per_row", MAX_BUCKET)
+    # one arrival stream, one deadline, sized off the SLOWER path so the
+    # comparison cannot hide misses behind a path-specific deadline
+    cap_r14 = MAX_BATCH / (r14_ms / 1e3)
+    deadline_ms = 6.0 * r14_ms
+    points = {}
+    launches0 = rt.stats.snapshot()["predict_kernel_launches"]
+    for label, charge_ms in (("fused", fused_ms), ("r14_model", r14_ms)):
+        clock = SimClock()
+        b = make_batcher(bank, name, clock, deadline_ms, charge_ms,
+                         "deadline")
+        rec = run_open_loop(b, clock, rows, 1500, rps=0.8 * cap_r14,
+                            deadline_ms=deadline_ms)
+        s = rec.summary()
+        points[label] = {
+            "dispatch_ms": charge_ms,
+            "latency_per_row_us": charge_ms * 1e3 / MAX_BUCKET,
+            "p99_ms": s["p99_ms"],
+            "miss_rate": s["miss_rate"],
+            "served": s["served"],
+        }
+    launches = (rt.stats.snapshot()["predict_kernel_launches"]
+                - launches0)
+    counts = predict_kernels_summary()
+    out = {
+        "timing": "tpu_launch_model_sim_clock",
+        "kernel_model": m,
+        "kernel_counts": counts,
+        "deadline_ms": deadline_ms,
+        "offered_rps_frac_of_r14_capacity": 0.8,
+        "paths": points,
+        "latency_per_row_drop_x": round(
+            points["r14_model"]["latency_per_row_us"]
+            / points["fused"]["latency_per_row_us"], 3),
+        "p99_drop_x": round(points["r14_model"]["p99_ms"]
+                            / points["fused"]["p99_ms"], 3),
+        "equal_miss_rate": (points["fused"]["miss_rate"]
+                            <= points["r14_model"]["miss_rate"]),
+        "fused_path_active": bool(info["fused_path"]),
+        "kernel_launches_per_dispatch":
+            info["kernel_launches_per_dispatch"],
+        "mega_kernel_launches_observed": launches,
+    }
+    print(f"fused_vs_r14: per-row "
+          f"{out['paths']['fused']['latency_per_row_us']:.2f}us vs "
+          f"{out['paths']['r14_model']['latency_per_row_us']:.2f}us "
+          f"(drop {out['latency_per_row_drop_x']}x), p99 drop "
+          f"{out['p99_drop_x']}x, launches/dispatch "
+          f"{out['kernel_launches_per_dispatch']}", flush=True)
+    return out
+
+
 def scenario_quantized(tmpdir):
     """r14 quantized PackedForest gates on a binary MARGIN task: int8
     and bf16 raw margins vs the f32 reference — per-precision AUC drift
@@ -585,6 +686,7 @@ def scenario_quantized(tmpdir):
         got = margins(bank)
         rt = bank.runtime("b")
         out[prec] = {
+            "fused_path": bool(rt.cache_info()["fused_path"]),
             "auc": auc_score(ye, got),
             "auc_drift": abs(auc_score(ye, got) - auc_ref),
             "max_abs_margin_err": float(np.max(np.abs(got - ref))),
@@ -624,7 +726,7 @@ def main():
     import jax
 
     n_trees = int(sys.argv[1]) if len(sys.argv) > 1 else 60
-    out_path = sys.argv[2] if len(sys.argv) > 2 else "BENCH_SERVE_r14.json"
+    out_path = sys.argv[2] if len(sys.argv) > 2 else "BENCH_SERVE_r18.json"
 
     booster, X = build_model(n_trees)
     packed = pack_booster(booster)
@@ -684,6 +786,14 @@ def main():
     scenarios["mesh_saturation_tier"] = scenario_mesh_tier(
         v1_path, rows, probe, dispatch_ms, baselines)
     scenarios["quantized_packedforest"] = scenario_quantized(tmpdir)
+
+    # --- r18: fused mega-kernel vs the r14 dispatch model --------------
+    fused_bank = ModelBank(max_bucket=MAX_BUCKET, max_cache_entries=16,
+                           warm_on_deploy=False, canary_rows=8,
+                           forest_precision="int8")
+    fused_bank.deploy("m", v1_path, raw_score=False)
+    scenarios["fused_vs_r14_dispatch"] = scenario_fused_vs_r14(
+        fused_bank, "m", packed, rows)
 
     mb4 = mesh_bank(v1_path, 4)
     mesh_baseline = mb4.predict("m", probe)
@@ -756,9 +866,30 @@ def main():
     }
     acceptance_r14["all_green"] = all(acceptance_r14.values())
 
+    fus = scenarios["fused_vs_r14_dispatch"]
+    acceptance_r18 = {
+        "fused_path_default": fus["fused_path_active"]
+            and all(qz[p]["fused_path"] for p in ("bf16", "int8")),
+        "latency_per_row_improved": fus["latency_per_row_drop_x"] > 1.0,
+        "p99_improved_at_equal_miss_rate":
+            fus["p99_drop_x"] > 1.0 and fus["equal_miss_rate"],
+        "launch_drop_ge_4x_vs_r14_model":
+            fus["kernel_counts"]["predict_drop_within_floor"],
+        "tpu_launch_model_within_budget":
+            fus["kernel_counts"]["predict_within_budget"],
+        "no_f32_node_table_resident":
+            fus["kernel_model"]["f32_node_table_bytes"] == 0,
+        "mega_kernel_launches_accounted":
+            fus["mega_kernel_launches_observed"] > 0,
+        "int8_auc_drift_le_1e_4": qz["int8"]["auc_drift"] <= 1e-4,
+        "threshold_bound_hard_error": qz["threshold_bound_rejected"],
+        "slo_budgets_ok": all(r["ok"] for r in slo),
+    }
+    acceptance_r18["all_green"] = all(acceptance_r18.values())
+
     artifact = {
         "bench": "serving_loadgen",
-        "round": 14,
+        "round": 18,
         "backend": jax.default_backend(),
         "model": {"n_trees": packed.num_trees,
                   "n_features": packed.num_feature(),
@@ -781,13 +912,15 @@ def main():
         "slo_budgets": slo,
         "acceptance_r12": acceptance,
         "acceptance_r14": acceptance_r14,
+        "acceptance_r18": acceptance_r18,
     }
     with open(out_path, "w") as f:
         json.dump(artifact, f, indent=2)
         f.write("\n")
-    green = acceptance["all_green"] and acceptance_r14["all_green"]
+    green = (acceptance["all_green"] and acceptance_r14["all_green"]
+             and acceptance_r18["all_green"])
     status = "ALL GREEN" if green else "RED"
-    print(f"wrote {out_path}; acceptance_r12+r14 {status}")
+    print(f"wrote {out_path}; acceptance_r12+r14+r18 {status}")
     return 0 if green else 1
 
 

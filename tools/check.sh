@@ -105,7 +105,19 @@ echo "== freshness (refresh pipeline + staleness SLO) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_freshness.py -q \
   -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
-# 9. sweep: the r17 tune surface — scheduler mesh plans, crash-safe
+# 9. predict-fused: the r18 serving device path — fused mega-kernel
+#    parity vs the legacy scan and the numpy oracle across precision x
+#    tree-shape x multiclass (staged windows included), bin-edge routing
+#    in quantized space, ThresholdBoundError at ingest, compact-dtype
+#    residency (no f32 node table), mega-kernel launch accounting, and
+#    full-compile-key warm coverage on the quantized dp route.  The
+#    launch budgets + fused SLO models already ran in the lint-v2 layer
+#    above (launch_budgets / serve_slo / predict anchors).
+echo "== predict-fused (mega-kernel parity + residency) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_predict_fused.py -q \
+  -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# 10. sweep: the r17 tune surface — scheduler mesh plans, crash-safe
 #    ledger (atomic saves, sentinel-proof leaderboard, RData/JSON
 #    merge), kill-anywhere hyper-batch resume with FILE-level byte
 #    parity on both codecs, the daemon's sweep -> canary -> flip
