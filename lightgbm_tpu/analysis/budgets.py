@@ -815,7 +815,8 @@ PCIE_PUT_LATENCY_S = 20e-6
 def stream_prefetch_time(block_rows: int = REF_ROWS_PER_SHARD,
                          num_features: int = 136, num_bins: int = 256,
                          num_segments: int = 2, n_blocks: int = 8,
-                         code_bytes: int = 1) -> Dict[str, float]:
+                         code_bytes: int = 1,
+                         prefetch_blocks: int = 1) -> Dict[str, float]:
     """Modeled wall-clock for one streamed histogram pass: transfer vs
     overlapped compute under the double-buffered prefetcher.
 
@@ -833,15 +834,26 @@ def stream_prefetch_time(block_rows: int = REF_ROWS_PER_SHARD,
     uint8 blocks, F=136, B=256, S=2) transfer is ~1.1 ms/block vs
     ~2.7 ms/block of compute: comfortably hidden, and the verdict holds
     down to ~2.5x error in the bandwidth constant.
+
+    ``prefetch_blocks`` (r19 satellite) is the configurable lookahead
+    depth (``stream_prefetch_blocks``): with >=2 puts outstanding the
+    NEXT put's host-side launch overhead overlaps the in-flight
+    transfer's bytes, so steady state serializes only the link's byte
+    time; depth 1 (double buffer, the default) exposes the launch
+    latency on every block.  Deeper pipelines never hurt under this
+    model — the link bandwidth is the invariant floor.
     """
     k = max(int(n_blocks), 1)
+    depth = max(int(prefetch_blocks), 1)
     bytes_per_block = float(block_rows) * num_features * code_bytes
-    transfer_s = bytes_per_block / PCIE_BYTES_PER_S + PCIE_PUT_LATENCY_S
+    byte_s = bytes_per_block / PCIE_BYTES_PER_S
+    fill_s = byte_s + PCIE_PUT_LATENCY_S
+    steady_s = byte_s + (PCIE_PUT_LATENCY_S if depth == 1 else 0.0)
     flops = 2.0 * block_rows * num_bins * 3 * num_segments * num_features
     compute_s = flops / MXU_EFF_FLOPS
-    total_transfer_s = k * transfer_s
+    total_transfer_s = fill_s + (k - 1) * steady_s
     total_compute_s = k * compute_s
-    makespan = (transfer_s + (k - 1) * max(transfer_s, compute_s)
+    makespan = (fill_s + (k - 1) * max(steady_s, compute_s)
                 + compute_s)
     exposed_s = max(makespan - total_compute_s, 0.0)
     hidden_s = total_transfer_s - exposed_s
@@ -851,7 +863,7 @@ def stream_prefetch_time(block_rows: int = REF_ROWS_PER_SHARD,
             "hidden_ms": hidden_s * 1e3,
             "hidden_frac": (hidden_s / total_transfer_s
                             if total_transfer_s > 0 else 0.0),
-            "compute_bound": compute_s >= transfer_s}
+            "compute_bound": compute_s >= steady_s}
 
 
 @dataclass(frozen=True)
@@ -872,13 +884,15 @@ class StreamTimeBudget:
     num_segments: int = 2
     n_blocks: int = 8
     code_bytes: int = 1
+    prefetch_blocks: int = 1
     note: str = ""
 
     def check(self) -> Dict[str, object]:
         t = stream_prefetch_time(
             self.block_rows, self.num_features, self.num_bins,
             self.num_segments, n_blocks=self.n_blocks,
-            code_bytes=self.code_bytes)
+            code_bytes=self.code_bytes,
+            prefetch_blocks=self.prefetch_blocks)
         frac = t["hidden_frac"]
         return {"name": self.name, "mode": "stream_prefetch",
                 "measured": round(frac, 4),
@@ -896,6 +910,10 @@ STREAM_TIME_BUDGETS: Tuple[StreamTimeBudget, ...] = (
     StreamTimeBudget("stream_prefetch_hidden_strict_ref", 0.60,
                      num_segments=2, n_blocks=16,
                      note="deeper stores only hide more (1 - 1/K)"),
+    StreamTimeBudget("stream_prefetch_hidden_deep_ref", 0.60,
+                     prefetch_blocks=2,
+                     note="r19 satellite: depth-2 lookahead overlaps the "
+                          "put launch latency too — modeled, not guessed"),
 )
 
 
@@ -910,6 +928,227 @@ def check_stream_budgets(names: Optional[List[str]] = None
                          ) -> List[Dict[str, object]]:
     specs = (STREAM_TIME_BUDGETS if names is None
              else [stream_budget_by_name(n) for n in names])
+    return [b.check() for b in specs]
+
+
+# ---------------------------------------------------------------------------
+# Streamed x dp composition (r19): per-block-round merge overlap + the
+# GOSS x wire combined byte model
+# ---------------------------------------------------------------------------
+
+
+def stream_dp_time_model(block_rows: int = REF_ROWS_PER_SHARD,
+                         num_features: int = 136, num_bins: int = 256,
+                         num_segments: int = 2,
+                         n_blocks_per_shard: int = 8, n_shards: int = 8,
+                         mode: str = "reduce_scatter_pipelined",
+                         wire_dtype: str = "f32", n_chunks: int = 4,
+                         code_bytes: int = 1,
+                         prefetch_blocks: int = 1) -> Dict[str, float]:
+    """Modeled wall-clock for ONE streamed-dp histogram pass: the r11
+    PCIe prefetch pipeline composed with the r10 per-block-round ICI
+    merge (data/stream_dp.py).
+
+    Per block-round every shard (a) receives its next block over PCIe,
+    (b) runs the per-block histogram kernel, and (c) ring-merges the
+    partial — and the merge of block ``j`` flies while block ``j+1``'s
+    prefetch + compute proceed, a three-stage pipeline:
+
+        span = pcie_fill + (K-1) * max(pcie, compute, merge)
+               + compute + merge [+ gather]
+
+    Exposed merge time is what the merge ADDS over the merge-free r11
+    makespan (``stream_prefetch_time``), plus — under the
+    reduce-scatter modes — the ONE per-iteration all-gather of the
+    feature-sharded accumulator back to the replicated update
+    (``(D-1)/D`` of the f32 histogram; psum pays no gather but ships
+    f32 every round).  At D=8/F=136/B=256 the per-block merge is tens
+    of microseconds against ~2.7 ms of compute, so
+    ``merge_hidden_frac -> 1 - 1/K`` minus the gather term — >=60%
+    with margin, robust to ~10x error in either wire constant.
+    """
+    k = max(int(n_blocks_per_shard), 1)
+    d = max(int(n_shards), 1)
+    base = stream_prefetch_time(
+        block_rows, num_features, num_bins, num_segments, n_blocks=k,
+        code_bytes=code_bytes, prefetch_blocks=prefetch_blocks)
+    b = hist_merge_comm_bytes(
+        mode, d, num_features, num_bins, num_segments,
+        wire_dtype=wire_dtype, n_chunks=n_chunks)
+    chunks = (max(int(n_chunks), 1)
+              if mode == "reduce_scatter_pipelined" else 1)
+    if mode == "psum":
+        hops = 2 * (d - 1)
+    else:
+        hops = (d - 1) * chunks
+    merge_s = (b["ring_wire_bytes_per_shard"] / ICI_BYTES_PER_S
+               + hops * ICI_HOP_LATENCY_S)
+    pcie_byte_s = float(block_rows) * num_features * code_bytes \
+        / PCIE_BYTES_PER_S
+    steady_pcie_s = pcie_byte_s + (
+        PCIE_PUT_LATENCY_S if max(int(prefetch_blocks), 1) == 1 else 0.0)
+    fill_s = pcie_byte_s + PCIE_PUT_LATENCY_S
+    compute_s = (2.0 * block_rows * num_bins * 3 * num_segments
+                 * num_features) / MXU_EFF_FLOPS
+    span = (fill_s + (k - 1) * max(steady_pcie_s, compute_s, merge_s)
+            + compute_s + merge_s)
+    # rs modes: ONE gather per split iteration of the (D-1)/D remote
+    # f32 slice; psum returns replicated partials every round instead
+    hist_f32_bytes = (float(num_features) * num_bins * 3 * num_segments
+                      * 4)
+    gather_s = (0.0 if mode == "psum"
+                else hist_f32_bytes * (d - 1) / d / ICI_BYTES_PER_S
+                + (d - 1) * ICI_HOP_LATENCY_S)
+    base_span_s = fill_s + (k - 1) * max(steady_pcie_s, compute_s) \
+        + compute_s
+    exposed_merge_s = max(span - base_span_s, 0.0) + gather_s
+    total_merge_s = k * merge_s + gather_s
+    hidden_s = max(total_merge_s - exposed_merge_s, 0.0)
+    return {"pcie_ms": base["transfer_ms"],
+            "compute_ms": base["compute_ms"],
+            "merge_ms": total_merge_s * 1e3,
+            "gather_ms": gather_s * 1e3,
+            "exposed_merge_ms": exposed_merge_s * 1e3,
+            "hidden_ms": hidden_s * 1e3,
+            "merge_hidden_frac": (hidden_s / total_merge_s
+                                  if total_merge_s > 0 else 0.0),
+            "span_ms": (span + gather_s) * 1e3,
+            "compute_bound": compute_s >= max(merge_s, steady_pcie_s)}
+
+
+def stream_dp_bytes_model(rows_per_shard: int = REF_ROWS_PER_SHARD,
+                          num_features: int = 136, num_bins: int = 256,
+                          num_segments: int = 2, n_shards: int = 8,
+                          top_rate: float = 0.1, other_rate: float = 0.1,
+                          wire_dtype: str = "int8", n_chunks: int = 4,
+                          code_bytes: int = 1,
+                          iters_per_pass: int = 1) -> Dict[str, float]:
+    """GOSS x wire compounding (r19): combined PCIe+ICI bytes one shard
+    moves per histogram pass, sampled-int8 vs the full-f32 streamed-dp
+    baseline.
+
+    The two reductions act on DIFFERENT links, so they multiply within
+    each term rather than saturating one bottleneck: GOSS-at-the-source
+    shrinks the PCIe term by ``top_rate + other_rate`` (only sampled
+    rows are gathered across the host link, measured by the per-shard
+    ``bytes_streamed`` odometers), while the quantized wire shrinks the
+    ICI ring-hop term by ~4x (int8 stat columns; the count column rides
+    quantized too under the r10 wire codec).  At the
+    D=8/F=136/B=256/131072-row reference with 0.1/0.1 GOSS the combined
+    reduction is ~4.8x — the >=4x acceptance line with headroom.
+    """
+    d = max(int(n_shards), 1)
+    pcie_full = float(rows_per_shard) * num_features * code_bytes
+    sample = min(max(float(top_rate) + float(other_rate), 0.0), 1.0)
+    pcie_goss = pcie_full * sample
+    full = hist_merge_comm_bytes(
+        "reduce_scatter_pipelined", d, num_features, num_bins,
+        num_segments, wire_dtype="f32", n_chunks=n_chunks)
+    wire = hist_merge_comm_bytes(
+        "reduce_scatter_pipelined", d, num_features, num_bins,
+        num_segments, wire_dtype=wire_dtype, n_chunks=n_chunks)
+    it = max(int(iters_per_pass), 1)
+    ici_full = full["ring_wire_bytes_per_shard"] * it
+    ici_wire = wire["ring_wire_bytes_per_shard"] * it
+    baseline = pcie_full + ici_full
+    combined = pcie_goss + ici_wire
+    return {"pcie_baseline_bytes": pcie_full,
+            "pcie_goss_bytes": pcie_goss,
+            "ici_f32_bytes": ici_full,
+            "ici_wire_bytes": ici_wire,
+            "baseline_bytes": baseline,
+            "combined_bytes": combined,
+            "reduction_factor": (baseline / combined
+                                 if combined > 0 else float("inf")),
+            "pcie_factor": (pcie_full / pcie_goss
+                            if pcie_goss > 0 else float("inf")),
+            "ici_factor": (ici_full / ici_wire
+                           if ici_wire > 0 else float("inf"))}
+
+
+@dataclass(frozen=True)
+class StreamDpBudget:
+    """One streamed-dp acceptance line (r19): either a floor on the
+    merge-hidden fraction of :func:`stream_dp_time_model` (``kind=
+    "hidden"``) or a floor on the combined byte-reduction factor of
+    :func:`stream_dp_bytes_model` (``kind="bytes"``), both at the
+    D=8/F=136/B=256 reference shape."""
+
+    name: str
+    kind: str                   # "hidden" | "bytes"
+    floor: float
+    n_shards: int = 8
+    num_features: int = 136
+    num_bins: int = 256
+    num_segments: int = 2
+    block_rows: int = REF_ROWS_PER_SHARD
+    n_blocks_per_shard: int = 8
+    mode: str = "reduce_scatter_pipelined"
+    wire_dtype: str = "f32"
+    n_chunks: int = 4
+    top_rate: float = 0.1
+    other_rate: float = 0.1
+    note: str = ""
+
+    def check(self) -> Dict[str, object]:
+        if self.kind == "hidden":
+            t = stream_dp_time_model(
+                self.block_rows, self.num_features, self.num_bins,
+                self.num_segments, self.n_blocks_per_shard,
+                self.n_shards, self.mode, self.wire_dtype, self.n_chunks)
+            measured = t["merge_hidden_frac"]
+            detail = {"merge_ms": round(t["merge_ms"], 4),
+                      "exposed_ms": round(t["exposed_merge_ms"], 4),
+                      "compute_ms": round(t["compute_ms"], 3)}
+        else:
+            m = stream_dp_bytes_model(
+                self.block_rows, self.num_features, self.num_bins,
+                self.num_segments, self.n_shards, self.top_rate,
+                self.other_rate, self.wire_dtype, self.n_chunks)
+            measured = m["reduction_factor"]
+            detail = {"baseline_mb": round(m["baseline_bytes"] / 1e6, 3),
+                      "combined_mb": round(m["combined_bytes"] / 1e6, 3),
+                      "pcie_factor": round(m["pcie_factor"], 2),
+                      "ici_factor": round(m["ici_factor"], 2)}
+        return {"name": self.name, "mode": f"stream_dp_{self.kind}",
+                "measured": round(measured, 4), "budget": self.floor,
+                "ok": measured >= self.floor, "note": self.note,
+                **detail}
+
+
+STREAM_DP_BUDGETS: Tuple[StreamDpBudget, ...] = (
+    StreamDpBudget(
+        "stream_dp_merge_hidden_ref", "hidden", 0.60,
+        note="r19 acceptance: >=60% of the per-block-round ring merge "
+             "hidden behind block compute at D=8/F=136/B=256"),
+    StreamDpBudget(
+        "stream_dp_merge_hidden_int8_ref", "hidden", 0.60,
+        wire_dtype="int8",
+        note="int8 wire shrinks hops 4x — overlap floor unchanged"),
+    StreamDpBudget(
+        "stream_dp_merge_hidden_psum_ref", "hidden", 0.60,
+        mode="psum",
+        note="the A/B baseline merge must also stay hidden (no gather "
+             "term, 2x the ring bytes)"),
+    StreamDpBudget(
+        "stream_dp_goss_int8_bytes_ref", "bytes", 4.0,
+        wire_dtype="int8",
+        note="r19 acceptance: GOSS(0.1/0.1) x int8 wire moves >=4x "
+             "fewer combined PCIe+ICI bytes than full-f32 streamed-dp"),
+)
+
+
+def stream_dp_budget_by_name(name: str) -> StreamDpBudget:
+    for b in STREAM_DP_BUDGETS:
+        if b.name == name:
+            return b
+    raise KeyError(name)
+
+
+def check_stream_dp_budgets(names: Optional[List[str]] = None
+                            ) -> List[Dict[str, object]]:
+    specs = (STREAM_DP_BUDGETS if names is None
+             else [stream_dp_budget_by_name(n) for n in names])
     return [b.check() for b in specs]
 
 
@@ -1885,6 +2124,20 @@ BUDGET_ANCHORS: Dict[str, Tuple[Tuple[str, str], ...]] = {
     "stream": (
         ("lightgbm_tpu/data/block_store.py", "BlockStore"),
         ("lightgbm_tpu/data/stream_grow.py", "stream_goss_round"),
+    ),
+    "stream_dp": (
+        # r19 streamed x dp: the per-shard store splitter, the lockstep
+        # block-round assembler, the round drivers the time/byte models
+        # (stream_dp_time_model / stream_dp_bytes_model) charge, and
+        # the elastic-resume gate
+        ("lightgbm_tpu/data/block_store.py", "shard_block_store"),
+        ("lightgbm_tpu/data/stream_dp.py", "dp_block_rounds"),
+        ("lightgbm_tpu/data/stream_dp.py", "stream_dp_grow_tree"),
+        ("lightgbm_tpu/data/stream_dp.py", "stream_dp_goss_round"),
+        ("lightgbm_tpu/analysis/budgets.py", "stream_dp_time_model"),
+        ("lightgbm_tpu/analysis/budgets.py", "stream_dp_bytes_model"),
+        ("lightgbm_tpu/training/checkpoint.py",
+         "validate_parallel_topology"),
     ),
     "serve_slo": (
         ("lightgbm_tpu/serving/runtime.py", "PredictorRuntime"),

@@ -122,7 +122,7 @@ def _run(argv: Optional[List[str]] = None) -> int:
     from .budgets import (check_ckpt_budgets, check_comm_budgets,
                           check_comm_time_budgets, check_freshness_budgets,
                           check_serve_slo_budgets, check_stream_budgets,
-                          check_sweep_budgets)
+                          check_stream_dp_budgets, check_sweep_budgets)
 
     res = check_comm_budgets()
     sections["comm_budgets"] = res
@@ -134,6 +134,10 @@ def _run(argv: Optional[List[str]] = None) -> int:
 
     res = check_stream_budgets()
     sections["stream_time"] = res
+    failed |= any(not r["ok"] for r in res)
+
+    res = check_stream_dp_budgets()
+    sections["stream_dp"] = res
     failed |= any(not r["ok"] for r in res)
 
     res = check_serve_slo_budgets()
@@ -202,8 +206,9 @@ def _run(argv: Optional[List[str]] = None) -> int:
         for line in l1["stale_suppressions"]:
             print(f"stale baseline entry: {line}")
         for key in ("vmem", "comm_budgets", "comm_time", "stream_time",
-                    "serve_slo", "ckpt", "freshness", "sweep",
-                    "budget_anchors", "launch_budgets", "recompile"):
+                    "stream_dp", "serve_slo", "ckpt", "freshness",
+                    "sweep", "budget_anchors", "launch_budgets",
+                    "recompile"):
             for r in sections.get(key, ()):
                 mark = "ok" if r["ok"] else "FAIL"
                 detail = (f"{r['estimated_mb']}/{r['budget_mb']} MB"

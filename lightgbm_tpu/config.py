@@ -325,6 +325,12 @@ _FRAMEWORK_KEYS = {
                            # sketch (def. 200k, matching the in-memory
                            # fit's sample_cnt)
     "stream_sketch_eps",   # GK sketch rank-error target (def. 1e-3)
+    "stream_prefetch_blocks",  # out-of-core: device-put lookahead depth
+                           # in blocks (def. 1 = double buffer; deeper
+                           # pipelines modeled by stream_prefetch_time)
+    "stream_dp_devices",   # streamed x dp: cap the row-mesh device count
+                           # (def. 0 = all visible; elastic resume pins
+                           # the writer's D here when shrinking a fleet)
     "checkpoint_rounds",   # fault-tolerant training (r13): auto-checkpoint
                            # cadence in rounds (def. 10 — <=5% overhead per
                            # analysis.budgets.CKPT_BUDGETS)

@@ -81,6 +81,10 @@ class BlockStore:
         self.num_rows = int(num_rows)
         self.block_rows = _check_block_rows(block_rows)
         self.bytes_streamed = 0    # PCIe byte odometer (bench/budget hooks)
+        self.prefetch_blocks = 1   # host->HBM lookahead depth (r19:
+        #   ``stream_prefetch_blocks`` — how many blocks ahead of the
+        #   consumer the device_put pipeline runs; budgeted by
+        #   ``analysis.budgets.stream_prefetch_time``)
         if len(blocks) > 1:
             for k, b in enumerate(blocks):
                 if b.shape[0] != self.block_rows:
@@ -102,6 +106,9 @@ class BlockStore:
         self._sleep = time.sleep       # injectable (tests pin to no-op)
         self.read_retries = 0          # absorbed-transient odometer
         self.quarantined: set = set()  # block indices that failed verify
+        self.device = None             # pinned target device (streamed-dp:
+        #   each per-shard store transfers onto its OWN mesh device so D
+        #   PCIe pipelines run concurrently; None = default device)
 
     @property
     def num_blocks(self) -> int:
@@ -164,7 +171,8 @@ class BlockStore:
                 b = self._verify_block(k)
                 if self.fault_injector is not None:
                     self.fault_injector.check("device_put")
-                return jax.device_put(b)
+                return (jax.device_put(b) if self.device is None
+                        else jax.device_put(b, self.device))
             except OOCBlockError:
                 raise                      # quarantined: not transient
             except (FaultError, RuntimeError, OSError) as e:
@@ -175,16 +183,31 @@ class BlockStore:
             kind="read",
             attempts=self.max_read_retries + 1) from last
 
-    def device_blocks(self) -> Iterator[Tuple[int, "object"]]:
-        """Yield ``(row_offset, device_block)`` with one-block lookahead:
-        block k+1's ``jax.device_put`` is issued BEFORE block k is handed
-        to the consumer, so its host->HBM copy runs while the consumer's
-        histogram kernel chews on block k (async dispatch)."""
-        nxt = self._fetch_device(0)
-        for k in range(len(self.blocks)):
-            cur = nxt
-            if k + 1 < len(self.blocks):
-                nxt = self._fetch_device(k + 1)
+    def device_blocks(self, prefetch_blocks: int = None
+                      ) -> Iterator[Tuple[int, "object"]]:
+        """Yield ``(row_offset, device_block)`` with ``prefetch_blocks``
+        lookahead: blocks k+1..k+P have their ``jax.device_put`` issued
+        BEFORE block k is handed to the consumer, so their host->HBM
+        copies run while the consumer's histogram kernel chews on block k
+        (async dispatch).  Depth defaults to the store's configured
+        ``prefetch_blocks`` (the ``stream_prefetch_blocks`` param); depth
+        1 is the classic double buffer."""
+        depth = self.prefetch_blocks if prefetch_blocks is None \
+            else int(prefetch_blocks)
+        if depth < 1:
+            raise ValueError(
+                f"prefetch_blocks={depth} must be >= 1 (1 = double "
+                "buffer)")
+        from collections import deque
+
+        window: deque = deque()
+        n = len(self.blocks)
+        for k in range(min(depth, n)):
+            window.append(self._fetch_device(k))
+        for k in range(n):
+            cur = window.popleft()
+            if k + depth < n:
+                window.append(self._fetch_device(k + depth))
             self.bytes_streamed += self.blocks[k].nbytes
             yield k * self.block_rows, cur
 
@@ -212,6 +235,51 @@ class BlockStore:
     @staticmethod
     def writer(block_rows: int) -> "_BlockWriter":
         return _BlockWriter(block_rows)
+
+
+def shard_block_store(store: BlockStore, n_shards: int
+                      ) -> List[BlockStore]:
+    """Split a multi-block store into ``n_shards`` per-shard stores over
+    CONTIGUOUS block ranges (the streamed × dp composition, ISSUE r19).
+
+    Each shard is a real :class:`BlockStore` — same blocks by reference
+    (no copy), its own ``bytes_streamed`` PCIe odometer, the parent's
+    fault-injection / verify config — so shard ``s`` can run the full
+    prefetch pipeline against its own device while shard ``s+1`` does the
+    same.  Contiguity keeps the global row order ``shard-major``, which
+    is exactly ``shard_rows``'s layout for the resident vectors: row
+    ``i`` of shard ``s`` is global row ``s*rows_per_shard + i``.
+
+    Requires ``num_blocks % n_shards == 0`` (the Booster picks the
+    device count as a divisor of the block count, so per-shard block
+    walks stay in lockstep and every block-round is a full-mesh
+    collective).
+    """
+    n_shards = int(n_shards)
+    if n_shards < 1:
+        raise ValueError(f"n_shards={n_shards} must be >= 1")
+    if store.num_blocks % n_shards:
+        raise ValueError(
+            f"cannot shard {store.num_blocks} blocks across "
+            f"{n_shards} devices: block count must be divisible so "
+            "per-shard block walks stay in lockstep")
+    per = store.num_blocks // n_shards
+    rows_per_shard = per * store.block_rows
+    shards: List[BlockStore] = []
+    for s in range(n_shards):
+        lo = s * rows_per_shard
+        real = max(0, min(store.num_rows - lo, rows_per_shard))
+        sh = BlockStore(store.blocks[s * per:(s + 1) * per],
+                        max(real, 1), store.block_rows)
+        sh.num_rows = real          # may be 0 for all-padding tail shards
+        sh.verify_checksums = store.verify_checksums
+        sh.fault_injector = store.fault_injector
+        sh.max_read_retries = store.max_read_retries
+        sh.retry_backoff_s = store.retry_backoff_s
+        sh._sleep = store._sleep
+        sh.prefetch_blocks = store.prefetch_blocks
+        shards.append(sh)
+    return shards
 
 
 class _BlockWriter:

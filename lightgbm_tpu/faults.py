@@ -91,6 +91,22 @@ class FaultError(RuntimeError):
     """A deterministically injected fault."""
 
 
+class StreamScopeError(ValueError):
+    """A parameter the streamed (out-of-core) trainer does not cover.
+
+    The per-block grower kernels replicate the fused strict/wave bodies
+    without the categorical / monotone / extra-trees / interaction /
+    bynode machinery — training anyway would be subtly DIFFERENT, not
+    slower, so the fence is a hard typed error.  ``key`` names the exact
+    offending parameter so callers (and tests) can assert on the field
+    rather than parse prose.
+    """
+
+    def __init__(self, message: str, key: str = ""):
+        super().__init__(message)
+        self.key = key
+
+
 class NonFiniteGradientError(RuntimeError):
     """Diagnostic raised by the training finiteness screen.
 

@@ -929,13 +929,20 @@ class Booster:
         self._dp_mesh = None
         self._fp_mesh = None
         if self._streamed:
-            if p.tree_learner != "serial":
+            ds.block_store.prefetch_blocks = int(
+                p.extra.get("stream_prefetch_blocks", 1))
+            if p.tree_learner == "data":
+                # r19: streamed × data-parallel — per-shard BlockStores
+                # on the dp mesh with per-block-round merges
+                self._maybe_setup_stream_dp()
+            elif p.tree_learner != "serial":
                 import warnings
 
                 warnings.warn(
-                    f"tree_learner='{p.tree_learner}' is ignored under "
-                    "streamed (from_blocks) training — the block loop is a "
-                    "host loop; falling back to serial")
+                    f"tree_learner='{p.tree_learner}' is not routed under "
+                    "streamed (from_blocks) training — only 'data' "
+                    "composes with the block loop (r19); falling back to "
+                    "serial")
         elif p.tree_learner == "feature":
             self._maybe_setup_fp()
         elif p.tree_learner in ("data", "voting"):
@@ -946,31 +953,37 @@ class Booster:
         the per-block grower kernels replicate the fused strict/wave
         bodies without the categorical / monotone / extra-trees /
         interaction / bynode machinery, and multiclass & ranking need
-        per-round state the streamed round functions don't carry.  Reject
-        the rest loudly rather than train something subtly different."""
+        per-round state the streamed round functions don't carry.  Each
+        fence raises :class:`~lightgbm_tpu.faults.StreamScopeError`
+        naming the EXACT offending key (r19 satellite) rather than a
+        generic message — train something subtly different, never."""
+        from ..faults import StreamScopeError
+
         p = self.params
-        bad = None
+        bad = key = None
         if self._num_class > 1:
-            bad = "multiclass objectives"
+            bad, key = "multiclass objectives", "num_class"
         elif getattr(self.obj, "needs_group", False):
-            bad = f"ranking objective '{self.obj.name}'"
+            bad, key = f"ranking objective '{self.obj.name}'", "objective"
         elif p.linear_tree:
-            bad = "linear_tree"
+            bad = key = "linear_tree"
         elif p.extra_trees:
-            bad = "extra_trees"
+            bad = key = "extra_trees"
         elif self._mono_key is not None:
-            bad = "monotone_constraints"
+            bad = key = "monotone_constraints"
         elif self._ic_key is not None:
-            bad = "interaction_constraints"
+            bad = key = "interaction_constraints"
         elif self._cat_key is not None:
-            bad = "categorical features"
+            bad, key = "categorical features", "categorical_feature"
         elif p.feature_fraction_bynode < 1.0:
-            bad = "feature_fraction_bynode < 1"
+            bad, key = ("feature_fraction_bynode < 1",
+                        "feature_fraction_bynode")
         elif p.boosting == "dart":
-            bad = "boosting='dart'"
+            bad, key = "boosting='dart'", "boosting"
         if bad is not None:
-            raise ValueError(
-                f"streamed (from_blocks) training does not support {bad}")
+            raise StreamScopeError(
+                f"streamed (from_blocks) training does not support {bad} "
+                f"(unsupported key: {key})", key=key)
 
     def _resolve_monotone_constraints(self) -> Optional[tuple]:
         """Map user ``monotone_constraints`` (per ORIGINAL feature) onto the
@@ -1382,6 +1395,63 @@ class Booster:
             self._fp_mesh, jnp.asarray(padded), jnp.asarray(base_mask))
         self._fp_width = padded.shape[1]
 
+    def _maybe_setup_stream_dp(self) -> None:
+        """Compose out-of-core streaming with the dp mesh (r19 tentpole):
+        split the block store into per-shard stores over contiguous block
+        ranges, pin each to its own device, and shard the O(n) resident
+        vectors row-wise so every device streams + scores ONLY its own
+        row range.  Falls back to serial streaming (with a warning) when
+        the mesh cannot be used, mirroring ``_maybe_setup_dp``."""
+        import warnings
+
+        from ..faults import StreamScopeError
+
+        p = self.params
+        if getattr(self.obj, "renew_alpha", None) is not None:
+            warnings.warn(
+                "tree_learner='data' under streamed training supports "
+                "gbdt/rf/goss without leaf renewal (the renewal pass "
+                "needs an extra full stream per round); training with "
+                "the serial block loop", stacklevel=3)
+            return
+        if p.extra.get("histogram_merge") == "voting":
+            # voting is a grower-level ballot, not a histogram merge the
+            # per-block-round collective can express
+            raise StreamScopeError(
+                "streamed (from_blocks) dp training does not support "
+                "histogram_merge='voting' — the PV-Tree ballot needs "
+                "in-memory per-shard split scans (unsupported key: "
+                "histogram_merge)", key="histogram_merge")
+        store = self.train_set.block_store
+        n_dev = len(jax.devices())
+        cap = int(p.extra.get("stream_dp_devices", 0))
+        if cap > 0:
+            n_dev = min(n_dev, cap)
+        from ..data.stream_dp import (choose_stream_dp_devices,
+                                      setup_stream_shards)
+
+        n_dev = choose_stream_dp_devices(store.num_blocks, n_dev)
+        if n_dev <= 1:
+            if len(jax.devices()) <= 1:
+                warnings.warn(
+                    "tree_learner='data' requested but only one device "
+                    "is visible; streaming serially", stacklevel=3)
+            else:
+                warnings.warn(
+                    f"tree_learner='data' requested but {store.num_blocks}"
+                    " block(s) admit no >1-device lockstep shard split; "
+                    "streaming serially", stacklevel=3)
+            return
+        from ..parallel.data_parallel import make_mesh, shard_rows
+
+        self._dp_mesh = make_mesh(n_dev)
+        self._stream_dp = True
+        self._stream_shards = setup_stream_shards(store, self._dp_mesh)
+        ds = self.train_set
+        (self._dp_y, self._dp_w, self._pred_train,
+         self._bag) = shard_rows(
+            self._dp_mesh, ds.y, self._w_eff, self._pred_train, self._bag)
+
     # -- continuation ----------------------------------------------------
     @property
     def _depth_cap(self) -> int:
@@ -1673,6 +1743,16 @@ class Booster:
         self._pred_train = jnp.asarray(arrays["pred_train"])
         self._bag = jnp.asarray(arrays["bag"])
         self._key = jnp.asarray(arrays["key"])
+        if getattr(self, "_dp_mesh", None) is not None and \
+                not getattr(self, "_dp_stats_only", False):
+            # elastic resume (r19): the checkpoint gathered these to host
+            # under the WRITER's device count; re-shard onto THIS run's
+            # row mesh — values are unchanged, only placement moves, so a
+            # D=8 checkpoint resumes bit-identically at D=4 (and back)
+            from ..parallel.data_parallel import shard_rows
+
+            self._pred_train, self._bag = shard_rows(
+                self._dp_mesh, self._pred_train, self._bag)
 
     def _sample_bag_and_fmask(self, i: int):
         """Per-round stochasticity shared by plain and DART rounds: resample
@@ -1750,7 +1830,40 @@ class Booster:
             hist_impl = p.extra.get("hist_impl", "auto")
             hist_dtype = resolve_hist_dtype(p, eff_rows)
             wave_width = resolve_wave_width(p, eff_rows)
-            if goss_k is not None:
+            if getattr(self, "_stream_dp", False):
+                # r19: streamed × dp — per-shard stores, per-block-round
+                # merges; GOSS samples per shard at the source
+                from ..data.stream_dp import (drain_shard_odometers,
+                                              stream_dp_goss_round,
+                                              stream_dp_plain_round)
+
+                merge_mode, _ = self._dp_merge_mode()
+                wire_dtype, merge_chunks = self._dp_wire(
+                    merge_mode, eff_rows)
+                if goss_k is not None:
+                    n_sh = len(self._stream_shards)
+                    goss_k_shard = (max(goss_k[0] // n_sh, 1),
+                                    max(goss_k[1] // n_sh, 1))
+                    tree, new_pred = stream_dp_goss_round(
+                        self._stream_shards, self._dp_mesh,
+                        self._obj_key, self._dp_y, self._dp_w,
+                        self._bag, self._pred_train, fmask, self._hyper,
+                        round_key, goss_k_shard, float(p.top_rate),
+                        float(p.other_rate), p.seed * 1_000_003 + i,
+                        p.num_leaves, self._num_bins, hist_impl,
+                        hist_dtype, wave_width, merge_mode, wire_dtype,
+                        merge_chunks)
+                else:
+                    tree, new_pred = stream_dp_plain_round(
+                        self._stream_shards, self._dp_mesh,
+                        self._obj_key, self._dp_y, self._dp_w,
+                        self._bag, self._pred_train, fmask, self._hyper,
+                        p.num_leaves, self._num_bins, hist_impl,
+                        hist_dtype, wave_width, p.boosting == "rf",
+                        merge_mode, wire_dtype, merge_chunks)
+                drain_shard_odometers(ds.block_store,
+                                      self._stream_shards)
+            elif goss_k is not None:
                 tree, new_pred = stream_goss_round(
                     ds.block_store, self._obj_key, ds.y, self._w_eff,
                     self._bag, self._pred_train, fmask, self._hyper,

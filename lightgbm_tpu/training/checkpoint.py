@@ -67,7 +67,15 @@ class CorruptCheckpointError(CheckpointError):
 
 class IncompatibleCheckpointError(CheckpointError):
     """Structurally valid checkpoint that cannot resume against the
-    offered Dataset / params (binning schema drift, version skew)."""
+    offered Dataset / params (binning schema drift, version skew, or —
+    r19 — an elastic-resume topology the writer's state cannot reshard
+    onto).  ``field`` names the offending meta field ("schema_digest",
+    "n_devices", "merge_mode", ...; "" when the mismatch is not
+    field-local) so callers can assert on the field, not the prose."""
+
+    def __init__(self, message: str, field: str = ""):
+        super().__init__(message)
+        self.field = field
 
 
 def _payload_bytes(arrays: Dict[str, np.ndarray], meta: dict) -> bytes:
@@ -172,7 +180,7 @@ def load_checkpoint(path: str) -> Tuple[Dict[str, np.ndarray], dict]:
     if version != CKPT_FORMAT_VERSION:
         raise IncompatibleCheckpointError(
             f"{path}: checkpoint format v{version} != supported "
-            f"v{CKPT_FORMAT_VERSION}")
+            f"v{CKPT_FORMAT_VERSION}", field="format_version")
     digest = blob[len(CKPT_MAGIC) + 4:_HEADER_LEN]
     payload = blob[_HEADER_LEN:]
     if hashlib.sha256(payload).digest() != digest:
@@ -219,7 +227,7 @@ def load_latest(directory: str) -> Tuple[Optional[str], dict]:
     return None, {"arrays": None, "meta": None, "rejected": rejected}
 
 
-def resume_booster(source, train_set):
+def resume_booster(source, train_set, params=None):
     """Rebuild a Booster mid-run from a checkpoint + the training data.
 
     ``source`` is a checkpoint path or a preloaded ``(arrays, meta)``
@@ -229,6 +237,14 @@ def resume_booster(source, train_set):
     one trained on, verified via the stored sketch digest
     (:class:`IncompatibleCheckpointError` otherwise — rebinned data
     would silently reinterpret every split threshold).
+
+    ``params`` (r19, optional) is the RESUME run's requested config —
+    ``train_resumable`` threads its own through — checked against the
+    checkpoint's recorded parallel topology by
+    :func:`validate_parallel_topology`: a requested histogram merge mode
+    different from the one the forest grew under rejects typed instead
+    of silently continuing with a different collective order.  The
+    device count itself is elastic (divisor/multiple reshards nest).
     """
     from ..config import parse_params
     from ..data.sketch import schema_digest
@@ -240,9 +256,9 @@ def resume_booster(source, train_set):
         arrays, meta = source
     params_dict = {k: v for k, v in meta["params"].items() if v is not None}
     metric = params_dict.pop("metric", None)
-    params = parse_params(params_dict, warn_unknown=False)
+    ckpt_params = parse_params(params_dict, warn_unknown=False)
     if metric:
-        params.metric = metric
+        ckpt_params.metric = metric
     train_set.construct()
     got = schema_digest(train_set.bin_mapper)
     want = meta.get("schema_digest")
@@ -251,7 +267,57 @@ def resume_booster(source, train_set):
             "checkpoint was trained under a different binning schema "
             f"(digest {want[:12]}… vs this Dataset's {got[:12]}…); "
             "rebuild the Dataset from the same source data / reference "
-            "before resuming")
-    booster = Booster(params, train_set)
+            "before resuming", field="schema_digest")
+    booster = Booster(ckpt_params, train_set)
+    validate_parallel_topology(booster, meta, requested=params)
     booster.restore_checkpoint_state(arrays, meta)
     return booster
+
+
+def validate_parallel_topology(booster, meta: dict, requested=None) -> None:
+    """Elastic-resume gate (r19): reject topology changes the writer's
+    state cannot reshard onto BEFORE any round runs.
+
+    The checkpoint's gathered arrays reshard onto any row mesh whose
+    device count is a divisor or multiple of the writer's — shard
+    boundaries then nest, placement moves, values don't, and a run
+    killed at D=8 resumes bit-identically at D=4 (or back up at D=8).
+    A foreign / non-divisible device count, or a different histogram
+    merge topology, would not fail loudly on its own: the round would
+    either die in a mid-round shape error or silently train under a
+    different collective order.  Both reject here with a typed
+    :class:`IncompatibleCheckpointError` naming the field.
+    """
+    old = dict(meta.get("parallel") or {})
+    old_d = int(old.get("n_devices", 1))
+    mesh = getattr(booster, "_dp_mesh", None) \
+        or getattr(booster, "_fp_mesh", None)
+    new_d = int(mesh.devices.size) if mesh is not None else 1
+    if old_d != new_d and (old_d < 1 or new_d < 1 or (
+            old_d % new_d and new_d % old_d)):
+        raise IncompatibleCheckpointError(
+            f"checkpoint was written at n_devices={old_d} and this resume "
+            f"resolved n_devices={new_d}: elastic resume needs the device "
+            "counts to divide one another so shard boundaries nest "
+            "(field: n_devices)", field="n_devices")
+    old_mode = old.get("merge_mode")
+    if old_mode is not None and getattr(booster, "_dp_mesh", None) \
+            is not None and not getattr(booster, "_dp2", False):
+        new_mode, _ = booster._dp_merge_mode()
+        if new_mode != old_mode:
+            raise IncompatibleCheckpointError(
+                f"checkpoint trained with histogram merge_mode="
+                f"{old_mode!r} but this resume resolved {new_mode!r}: "
+                "mixing merge topologies changes the partial-sum order "
+                "mid-forest (field: merge_mode)", field="merge_mode")
+    if requested is not None and old_mode is not None:
+        if hasattr(requested, "extra"):
+            req_mode = (requested.extra or {}).get("histogram_merge")
+        else:
+            req_mode = dict(requested or {}).get("histogram_merge")
+        if req_mode is not None and req_mode != old_mode:
+            raise IncompatibleCheckpointError(
+                f"resume config requests histogram_merge={req_mode!r} "
+                f"but the checkpoint's forest grew under {old_mode!r}: "
+                "mixing merge topologies changes the partial-sum order "
+                "mid-forest (field: merge_mode)", field="merge_mode")

@@ -132,7 +132,11 @@ def train_resumable(
     last_checkpoint = None
     if resume:
         if isinstance(resume, str):
-            booster = resume_booster(resume, train_set)
+            # elastic resume (r19): the caller's requested config rides
+            # along so a merge-topology change rejects typed up front;
+            # the device count itself may differ (divisor/multiple) —
+            # reshard-on-load nests the shard boundaries bit-identically
+            booster = resume_booster(resume, train_set, params=params)
             resumed_from = last_checkpoint = resume
         else:
             path, found = load_latest(checkpoint_dir)
@@ -141,7 +145,8 @@ def train_resumable(
                     f"skipping corrupt checkpoint {rej_path}: {why}")
             if path is not None:
                 booster = resume_booster(
-                    (found["arrays"], found["meta"]), train_set)
+                    (found["arrays"], found["meta"]), train_set,
+                    params=params)
                 resumed_from = last_checkpoint = path
     if booster is None and init_model is not None:
         booster = Booster(model_file=init_model)

@@ -1,0 +1,439 @@
+"""Streamed × data-parallel composition tests (ISSUE r19).
+
+Parity contract (PARITY.md): merged histogram MULTISETS are identical
+across device counts, but f32 summation GROUPING changes with D and the
+merge topology, so
+
+* where every histogram sum is exact in f32 — the dyadic tier below:
+  L2 objective, labels on the half-integer grid with an exact mean —
+  streamed-dp training is **bit-identical** (``np.array_equal`` on trees
+  AND predictions) to in-memory single-chip f32, any merge mode, any D;
+* on general data, streamed-dp matches the established dp bar: split
+  structure and row routing ``np.array_equal``, leaf values / preds to
+  f32 rounding (rtol 1e-5 / atol 1e-6).  int8/bf16 wire is
+  tolerance-gated by contract and never bit-claimed.
+
+Elastic resume (r13 × r19): a checkpoint written at D=8 restores
+bit-identically at any divisor/multiple D (reshard-on-load nests shard
+boundaries); incompatible topologies reject with a typed
+``IncompatibleCheckpointError`` naming the field, never a shape error
+mid-round.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.analysis.budgets import (check_stream_dp_budgets,
+                                           stream_dp_bytes_model,
+                                           stream_dp_time_model,
+                                           stream_prefetch_time)
+from lightgbm_tpu.data.block_store import BlockStore, shard_block_store
+from lightgbm_tpu.dataset import Dataset
+from lightgbm_tpu.faults import StreamScopeError
+from lightgbm_tpu.training.checkpoint import (IncompatibleCheckpointError,
+                                              resume_booster)
+
+BASE = dict(objective="l2", num_leaves=15, learning_rate=0.5,
+            min_data_in_leaf=5, max_bin=63, verbose=-1, seed=7,
+            deterministic=True)
+
+
+def _dyadic_problem(n, f, seed=0):
+    """Labels whose per-leaf gradient sums are EXACT in f32: y in {0,1}
+    with exactly n/2 ones, so init=0.5 and round-1 gradients are ±0.5 —
+    every histogram partial sum is exact regardless of summation order,
+    making round-1 trees bit-identical across D and merge topology."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0, 1, (n, f)).astype(np.float32)
+    w = rng.normal(0, 1, f)
+    logits = (X @ w) + 0.6 * np.sin(X[:, 0] * 2)
+    order = np.argsort(logits)
+    y = np.zeros(n, np.float32)
+    y[order[n // 2:]] = 1.0          # exactly n//2 ones (n is even)
+    return X, y
+
+
+def _general_problem(n, f, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0, 1, (n, f)).astype(np.float32)
+    w = rng.normal(0, 1, f)
+    y = ((X @ w) * 0.7 + 0.3 * np.sin(X[:, 0] * 2)
+         + 0.1 * rng.normal(size=n)).astype(np.float32)
+    return X, y
+
+
+def _blocks(X, y, block_rows):
+    return [(X[lo:lo + block_rows], y[lo:lo + block_rows])
+            for lo in range(0, len(X), block_rows)]
+
+
+def _trees_equal(a, b):
+    if len(a.trees) != len(b.trees):
+        return False
+    for ta, tb in zip(a.trees, b.trees):
+        for field in ("split_feature", "split_bin", "left", "right",
+                      "leaf_value", "is_leaf"):
+            if not np.array_equal(np.asarray(getattr(ta, field)),
+                                  np.asarray(getattr(tb, field))):
+                return False
+    return True
+
+
+def _trees_structure_close(a, b, rtol=1e-5, atol=1e-6):
+    assert len(a.trees) == len(b.trees)
+    for k, (ta, tb) in enumerate(zip(a.trees, b.trees)):
+        for field in ("split_feature", "split_bin", "left", "right",
+                      "is_leaf"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ta, field)),
+                np.asarray(getattr(tb, field)),
+                err_msg=f"tree {k} field {field}")
+        np.testing.assert_allclose(
+            np.asarray(ta.leaf_value), np.asarray(tb.leaf_value),
+            rtol=rtol, atol=atol, err_msg=f"tree {k} leaf_value")
+
+
+def _train_pair(X, y, block_rows, extra, rounds):
+    """In-memory single-chip vs streamed-dp boosters on the same data."""
+    p_mem = dict(BASE, row_chunk=block_rows, **extra)
+    p_mem.pop("histogram_merge", None)
+    p_mem.pop("histogram_wire", None)
+    mem = lgb.Booster(p_mem, Dataset(X, label=y, params=dict(p_mem)))
+    p_dp = dict(BASE, tree_learner="data", stream_block_rows=block_rows,
+                **extra)
+    dp = lgb.Booster(
+        p_dp, Dataset.from_blocks(_blocks(X, y, block_rows),
+                                  params=dict(p_dp)))
+    assert getattr(dp, "_stream_dp", False), "dp routing did not engage"
+    for _ in range(rounds):
+        mem.update()
+        dp.update()
+    return mem, dp
+
+
+# -- the composition parity matrix (acceptance #3) -----------------------
+
+MATRIX = [
+    # (features, n, block_rows) — 8-block stores shard 1 block/device,
+    # 16-block stores 2 blocks/device; ragged n exercises tail padding
+    (5, 1800, 256),       # 8 blocks (ragged 24-row tail), K_local=1
+    (13, 3996, 256),      # 16 blocks (ragged 156-row tail), K_local=2
+    (136, 2048, 256),     # wide Higgs/MSLR regime, 8 blocks, K_local=1
+]
+GROWERS = [("strict", {}), ("wave", {"wave_width": 4})]
+
+
+@pytest.mark.parametrize("gname,gextra", GROWERS,
+                         ids=[g[0] for g in GROWERS])
+@pytest.mark.parametrize("f,n,block_rows",
+                         MATRIX, ids=["f5", "f13x2blk", "f136"])
+def test_stream_dp_bit_identical_where_exact(gname, gextra, f, n,
+                                             block_rows):
+    """Dyadic tier: one round, every histogram sum exact -> full
+    bitwise parity (trees AND predictions) vs in-memory single chip."""
+    X, y = _dyadic_problem(n, f)
+    mem, dp = _train_pair(X, y, block_rows, gextra, rounds=1)
+    assert _trees_equal(mem, dp)
+    assert np.array_equal(np.asarray(mem.predict(X)),
+                          np.asarray(dp.predict(X)))
+
+
+@pytest.fixture(scope="module")
+def _general_mem():
+    """One in-memory reference training shared by both merge modes."""
+    X, y = _general_problem(3996, 13)
+    p = dict(BASE, row_chunk=256)
+    mem = lgb.Booster(p, Dataset(X, label=y, params=dict(p)))
+    for _ in range(3):
+        mem.update()
+    return X, y, mem
+
+
+@pytest.mark.parametrize("merge", ["psum", "reduce_scatter_pipelined"])
+def test_stream_dp_general_data_dp_parity_bar(merge, _general_mem):
+    """General data, multi-round: structure/routing exact, leaves to f32
+    rounding — the same bar the in-memory dp learners hold."""
+    X, y, mem = _general_mem
+    p = dict(BASE, tree_learner="data", stream_block_rows=256,
+             histogram_merge=merge)
+    dp = lgb.Booster(p, Dataset.from_blocks(_blocks(X, y, 256),
+                                            params=dict(p)))
+    assert dp._stream_dp
+    for _ in range(3):
+        dp.update()
+    _trees_structure_close(mem, dp)
+    np.testing.assert_allclose(np.asarray(mem.predict(X)),
+                               np.asarray(dp.predict(X)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_stream_dp_shards_and_odometers():
+    """Per-shard stores split the block walk; each shard's PCIe odometer
+    counts only its own range and the parent rolls them up.  (Same
+    n/F/block shape as the f13 matrix entry — reuses its compiles.)"""
+    X, y = _general_problem(3996, 13)
+    p = dict(BASE, tree_learner="data", stream_block_rows=256)
+    ds = Dataset.from_blocks(_blocks(X, y, 256), params=dict(p))
+    b = lgb.Booster(p, ds)
+    shards = b._stream_shards
+    assert len(shards) == 8 and all(s.num_blocks == 2 for s in shards)
+    b.update()
+    per_shard = [s.bytes_streamed for s in shards]
+    assert all(v > 0 for v in per_shard)
+    assert len(set(per_shard)) == 1          # equal ranges, equal bytes
+    assert ds.block_store.bytes_streamed == sum(per_shard)
+
+
+def test_stream_dp_goss_int8_compounds():
+    """GOSS-at-the-source × int8 wire: sampled per-shard gathers move
+    far fewer PCIe bytes than a full pass, in the same round the ring
+    hops carry int8 — and the trained model stays sane."""
+    X, y = _general_problem(3996, 13)
+    p = dict(BASE, tree_learner="data", stream_block_rows=256,
+             boosting="goss", top_rate=0.1, other_rate=0.1,
+             histogram_wire="int8", learning_rate=0.1)
+    ds = Dataset.from_blocks(_blocks(X, y, 256), params=dict(p))
+    b = lgb.Booster(p, ds)
+    assert b._stream_dp
+    full_pass = sum(blk.nbytes for s in b._stream_shards
+                    for blk in s.blocks)
+    before = [s.bytes_streamed for s in b._stream_shards]
+    b.update()
+    after = [s.bytes_streamed for s in b._stream_shards]
+    # per round each shard moves: one full-store predict pass (every
+    # row's score moves) + the sampled gather, which must be the ~20%
+    # sampled rows rather than a second full pass
+    gather = [a - bb for a, bb in zip(after, before)]
+    assert all(full_pass / 8 < g < 1.5 * full_pass / 8 for g in gather)
+    pred = np.asarray(b.predict(X))
+    assert np.isfinite(pred).all() and pred.std() > 0
+
+
+# -- elastic resume (acceptance #4) --------------------------------------
+
+
+def _ckpt_run(rounds_pre=2, rounds_post=3, n=3996, f=13):
+    X, y = _dyadic_problem(n, f)
+    p = dict(BASE, tree_learner="data", stream_block_rows=256,
+             learning_rate=0.5)
+    ds = Dataset.from_blocks(_blocks(X, y, 256), params=dict(p))
+    b = lgb.Booster(p, ds)
+    assert b._dp_mesh.devices.size == 8
+    for _ in range(rounds_pre):
+        b.update()
+    arrays, meta = b.checkpoint_state()
+    for _ in range(rounds_post):
+        b.update()
+    return X, y, p, b, arrays, meta
+
+
+def test_elastic_resume_same_d_bit_identical():
+    X, y, p, b8, arrays, meta = _ckpt_run()
+    ds = Dataset.from_blocks(_blocks(X, y, 256), params=dict(p))
+    br = resume_booster((arrays, meta), ds)
+    assert br._dp_mesh.devices.size == 8
+    for _ in range(3):
+        br.update()
+    assert _trees_equal(b8, br)
+    assert np.array_equal(np.asarray(b8.predict(X)),
+                          np.asarray(br.predict(X)))
+
+
+def test_elastic_resume_d8_to_d4():
+    """Kill at D=8, resume on a 4-device fleet: restored state and the
+    first post-resume tree (dyadic-exact sums) are bit-identical to the
+    D=8 continuation; the full continued run holds the dp parity bar."""
+    X, y, p, b8, arrays, meta = _ckpt_run(rounds_pre=2, rounds_post=1)
+    meta4 = dict(meta, params=dict(meta["params"], stream_dp_devices=4))
+    ds = Dataset.from_blocks(_blocks(X, y, 256), params=dict(p))
+    b4 = resume_booster((arrays, meta4), ds)
+    assert b4._dp_mesh.devices.size == 4
+    # restored forest is the writer's, bit for bit
+    assert len(b4.trees) == 2
+    for ta, tb in zip(b4.trees, b8.trees):
+        assert np.array_equal(np.asarray(ta.leaf_value),
+                              np.asarray(tb.leaf_value))
+    b4.update()
+    # round 3's gradients are NOT on the dyadic grid (leaf quotients),
+    # so cross-D equality holds on structure + f32-rounded leaves
+    _trees_structure_close(b8, b4)
+    np.testing.assert_allclose(np.asarray(b8.predict(X)),
+                               np.asarray(b4.predict(X)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_elastic_resume_first_round_bit_identical_across_d():
+    """Checkpoint BEFORE any round, resume at D=8 and at D=4: round 1's
+    histogram sums are dyadic-exact, so the two continuations grow a
+    bit-identical first tree — the 'bit-identical where comparable'
+    elastic guarantee."""
+    X, y = _dyadic_problem(3996, 13)
+    p = dict(BASE, tree_learner="data", stream_block_rows=256)
+    ds = Dataset.from_blocks(_blocks(X, y, 256), params=dict(p))
+    b = lgb.Booster(p, ds)
+    arrays, meta = b.checkpoint_state()
+    outs = []
+    for d in (8, 4):
+        m = dict(meta, params=dict(meta["params"], stream_dp_devices=d))
+        dsr = Dataset.from_blocks(_blocks(X, y, 256), params=dict(p))
+        br = resume_booster((arrays, m), dsr)
+        assert br._dp_mesh.devices.size == d
+        br.update()
+        outs.append(br)
+    assert _trees_equal(outs[0], outs[1])
+    assert np.array_equal(np.asarray(outs[0].predict(X)),
+                          np.asarray(outs[1].predict(X)))
+
+
+@pytest.fixture(scope="module")
+def _reject_ckpt():
+    """One 1-round D=8 checkpoint shared by the typed-rejection tests
+    (each doctors its own copy of the meta; arrays are read-only)."""
+    return _ckpt_run(rounds_pre=1, rounds_post=0)
+
+
+def test_elastic_resume_rejects_foreign_device_count(_reject_ckpt):
+    X, y, p, _, arrays, meta = _reject_ckpt
+    meta_f = dict(meta, parallel=dict(meta["parallel"], n_devices=3))
+    ds = Dataset.from_blocks(_blocks(X, y, 256), params=dict(p))
+    with pytest.raises(IncompatibleCheckpointError) as ei:
+        resume_booster((arrays, meta_f), ds)
+    assert ei.value.field == "n_devices"
+    assert "n_devices" in str(ei.value)
+
+
+def test_elastic_resume_rejects_non_divisible_reshard(_reject_ckpt):
+    X, y, p, _, arrays, meta = _reject_ckpt
+    # resume run resolves D=8 from the mesh; a writer at D=6 neither
+    # divides nor is divided by it
+    meta_nd = dict(meta, parallel=dict(meta["parallel"], n_devices=6))
+    ds = Dataset.from_blocks(_blocks(X, y, 256), params=dict(p))
+    with pytest.raises(IncompatibleCheckpointError) as ei:
+        resume_booster((arrays, meta_nd), ds)
+    assert ei.value.field == "n_devices"
+
+
+def test_elastic_resume_rejects_merge_mode_mismatch(_reject_ckpt):
+    X, y, p, _, arrays, meta = _reject_ckpt
+    assert meta["parallel"]["merge_mode"] == "reduce_scatter_pipelined"
+    ds = Dataset.from_blocks(_blocks(X, y, 256), params=dict(p))
+    with pytest.raises(IncompatibleCheckpointError) as ei:
+        resume_booster((arrays, meta), ds,
+                       params=dict(p, histogram_merge="psum"))
+    assert ei.value.field == "merge_mode"
+
+
+# -- typed scope fences (satellite) --------------------------------------
+
+
+@pytest.mark.parametrize("extra,key", [
+    (dict(boosting="dart"), "boosting"),
+    (dict(extra_trees=True), "extra_trees"),
+    (dict(feature_fraction_bynode=0.5), "feature_fraction_bynode"),
+    (dict(linear_tree=True), "linear_tree"),
+])
+def test_streamed_scope_errors_name_the_key(extra, key):
+    X, y = _general_problem(600, 5)
+    p = dict(BASE, stream_block_rows=256, **extra)
+    ds = Dataset.from_blocks(_blocks(X, y, 256), params=dict(p))
+    with pytest.raises(StreamScopeError) as ei:
+        lgb.Booster(p, ds)
+    assert ei.value.key == key
+    assert key in str(ei.value)
+
+
+def test_stream_dp_rejects_voting_merge_typed():
+    X, y = _general_problem(2048, 5)
+    p = dict(BASE, tree_learner="data", stream_block_rows=256,
+             histogram_merge="voting")
+    ds = Dataset.from_blocks(_blocks(X, y, 256), params=dict(p))
+    with pytest.raises(StreamScopeError) as ei:
+        lgb.Booster(p, ds)
+    assert ei.value.key == "histogram_merge"
+
+
+def test_stream_dp_single_block_falls_back_serial():
+    # serial-path trainability is test_streaming.py's job; here we pin
+    # only the routing: 1 block admits no >1-device lockstep split
+    X, y = _general_problem(500, 5)
+    p = dict(BASE, tree_learner="data", stream_block_rows=512)
+    ds = Dataset.from_blocks(_blocks(X, y, 512), params=dict(p))
+    with pytest.warns(UserWarning, match="lockstep"):
+        b = lgb.Booster(p, ds)
+    assert not getattr(b, "_stream_dp", False)
+    assert b._streamed
+
+
+# -- shard_block_store / prefetch depth (satellites) ---------------------
+
+
+def test_shard_block_store_contract():
+    codes = np.arange(8 * 256 * 3, dtype=np.uint8).reshape(-1, 3) % 250
+    store = BlockStore.from_binned(codes, 256)
+    shards = shard_block_store(store, 4)
+    assert [s.num_blocks for s in shards] == [2, 2, 2, 2]
+    assert sum(s.num_rows for s in shards) == store.num_rows
+    got = np.concatenate([np.asarray(b) for s in shards
+                          for _, b in s.device_blocks()])
+    assert np.array_equal(got, np.concatenate(
+        [np.asarray(b) for b in store.blocks]))
+    with pytest.raises(ValueError, match="shard"):
+        shard_block_store(store, 3)
+
+
+def test_block_store_prefetch_depth():
+    codes = np.arange(6 * 256 * 2, dtype=np.uint8).reshape(-1, 2) % 250
+    store = BlockStore.from_binned(codes, 256)
+    with pytest.raises(ValueError, match="prefetch"):
+        list(store.device_blocks(prefetch_blocks=0))
+    store.prefetch_blocks = 3
+    offs = [off for off, _ in store.device_blocks()]
+    assert offs == [0, 256, 512, 768, 1024, 1280]
+    assert store.bytes_streamed == sum(b.nbytes for b in store.blocks)
+
+
+def test_stream_prefetch_blocks_param_threads_to_store():
+    X, y = _general_problem(600, 5)
+    p = dict(BASE, stream_block_rows=256, stream_prefetch_blocks=2)
+    ds = Dataset.from_blocks(_blocks(X, y, 256), params=dict(p))
+    lgb.Booster(p, ds)
+    assert ds.block_store.prefetch_blocks == 2
+
+
+# -- budget models (satellite) -------------------------------------------
+
+
+def test_stream_dp_budgets_green():
+    res = check_stream_dp_budgets()
+    assert {r["name"] for r in res} >= {
+        "stream_dp_merge_hidden_ref", "stream_dp_goss_int8_bytes_ref"}
+    for r in res:
+        assert r["ok"], r
+
+
+def test_stream_dp_time_model_reference_point():
+    t = stream_dp_time_model()
+    assert t["merge_hidden_frac"] >= 0.60
+    assert t["compute_bound"]
+    # deeper prefetch never hurts the composed model either
+    deep = stream_dp_time_model(prefetch_blocks=2)
+    assert deep["merge_hidden_frac"] >= 0.60
+
+
+def test_stream_dp_bytes_model_compounds():
+    m = stream_dp_bytes_model()
+    assert m["reduction_factor"] >= 4.0
+    # the reductions act on different links: each factor alone is
+    # smaller than their compound
+    assert m["reduction_factor"] > min(m["pcie_factor"], m["ici_factor"])
+    f32 = stream_dp_bytes_model(wire_dtype="f32", top_rate=1.0,
+                                other_rate=0.0)
+    assert abs(f32["reduction_factor"] - 1.0) < 1e-9
+
+
+def test_stream_prefetch_depth_model_monotone():
+    shallow = stream_prefetch_time(prefetch_blocks=1)
+    deep = stream_prefetch_time(prefetch_blocks=2)
+    assert deep["hidden_frac"] >= 0.60
+    assert deep["transfer_ms"] <= shallow["transfer_ms"]

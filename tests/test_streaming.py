@@ -185,9 +185,20 @@ def test_streamed_scope_rejections(params):
 
 
 def test_streamed_tree_learner_falls_back_to_serial():
+    # r19: 'data' now routes to the real streamed-dp composition — only
+    # the learners the block loop can't express fall back (with a
+    # warning), and the dp route carries no warning at all
     with pytest.warns(UserWarning, match="serial"):
-        bst = _make_streamed(tree_learner="data")
+        bst = _make_streamed(tree_learner="feature")
     bst.update()     # trains fine on the serial path
+    assert len(bst.trees) == 1
+    assert not getattr(bst, "_stream_dp", False)
+
+
+def test_streamed_data_learner_routes_to_dp():
+    bst = _make_streamed(tree_learner="data")
+    assert getattr(bst, "_stream_dp", False)
+    bst.update()
     assert len(bst.trees) == 1
 
 

@@ -127,3 +127,16 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_predict_fused.py -q \
 echo "== sweep (distributed hyperparameter sweep + retune loop) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_sweep.py -q \
   -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# 11. streamed-dp: the r19 composition — per-shard BlockStores on the
+#     dp mesh with per-block-round pipelined merges: dyadic-tier
+#     bitwise parity vs in-memory single-chip, the general-data dp bar
+#     (structure exact, leaves at f32 rounding), GOSS-at-the-source ×
+#     int8 wire compounding with per-shard PCIe odometers, elastic
+#     D=8 -> D=4 resume with typed topology rejections, shard/prefetch
+#     store contracts, and the stream_dp time/byte models.  The
+#     STREAM_DP budget lines + anchors already ran in the lint layer
+#     above (stream_dp / budget_anchors sections).
+echo "== streamed-dp (dp-mesh streaming + elastic resume) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_stream_dp.py -q \
+  -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
