@@ -23,10 +23,13 @@ language.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Tuple
 
-from .rules import Finding
+from .rules import RULE_IDS, Finding
+
+_RULE_ID_RE = re.compile(r"GL\d{3}\Z")
 
 
 @dataclass
@@ -119,7 +122,24 @@ def parse_baseline(text: str) -> List[Suppression]:
             raise BaselineError(
                 f"baseline [[suppress]] #{i}: reason must be non-empty — "
                 f"accepted debt needs a justification")
-        out.append(Suppression(rule=str(t["rule"]), path=str(t["path"]),
+        rule = str(t["rule"])
+        # r20: a malformed or unknown rule id would suppress NOTHING and
+        # sit in the ledger forever looking like accepted debt — reject
+        # it at parse time, same as any other format error
+        if not _RULE_ID_RE.match(rule):
+            raise BaselineError(
+                f"baseline [[suppress]] #{i}: malformed rule id {rule!r} "
+                f"(expected GLxxx)")
+        if rule not in RULE_IDS and rule != "GL000":
+            raise BaselineError(
+                f"baseline [[suppress]] #{i}: unknown rule id {rule!r} "
+                f"(known: {', '.join(RULE_IDS)})")
+        if rule == "GL000":
+            raise BaselineError(
+                f"baseline [[suppress]] #{i}: GL000 (parse failure) is "
+                f"never baselineable — a tree that does not parse fails "
+                f"the gate, full stop")
+        out.append(Suppression(rule=rule, path=str(t["path"]),
                                count=count, reason=str(t["reason"])))
     return out
 

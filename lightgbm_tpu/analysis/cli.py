@@ -33,10 +33,34 @@ options:
   --no-vmem         skip the VMEM footprint estimates
   --no-baseline     report accepted debt too (ratchet view)
   --baseline PATH   alternate baseline file
+  --explain GLxxx   print the RULES.md section for a rule id and exit
   --format json     machine-readable report on stdout
   --format github   GitHub workflow-annotation lines (::error file=...)
   -q, --quiet       findings only, no summary
 """
+
+
+def _explain(rule_id: str) -> int:
+    """Print the RULES.md section for one rule id.  Unknown ids exit 2
+    with the usage-error one-liner (machine-readable, like every other
+    CLI misuse)."""
+    import os
+    import re
+
+    rid = rule_id.upper()
+    rules_md = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "RULES.md")
+    with open(rules_md, encoding="utf-8") as f:
+        text = f.read()
+    match = re.search(rf"^## {re.escape(rid)}\b.*?(?=^## |\Z)",
+                      text, re.M | re.S)
+    if match is None:
+        known = re.findall(r"^## (GL\d{3})\b", text, re.M)
+        print(f"graftlint: usage-error: unknown rule id {rule_id!r} "
+              f"(known: {', '.join(known)})", file=sys.stderr)
+        return 2
+    print(match.group(0).rstrip())
+    return 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -81,6 +105,13 @@ def _run(argv: Optional[List[str]] = None) -> int:
                 print("--baseline needs a path", file=sys.stderr)
                 return 2
             baseline_path = args[i]
+        elif a == "--explain":
+            i += 1
+            if i >= len(args):
+                print("graftlint: usage-error: --explain needs a rule id "
+                      "(e.g. GL012)", file=sys.stderr)
+                return 2
+            return _explain(args[i])
         elif a == "--format":
             i += 1
             if i >= len(args) or args[i] not in ("text", "json",

@@ -15,7 +15,7 @@ from typing import Iterable, List, Optional
 
 from .baseline import (BaselineResult, Suppression, apply_baseline,
                        parse_baseline)
-from .program import Program, fault_site_findings
+from .program import Program, fault_site_findings, parity_anchor_findings
 from .rules import Finding, analyze_source
 
 # Directories never linted: fixtures are deliberately-broken snippets,
@@ -78,8 +78,10 @@ def run_lint(paths: Optional[Iterable[str]] = None,
 
     With no explicit ``paths`` (the default pass) the whole package is
     analyzed as one :class:`~.program.Program`: traced/kernel closure
-    crosses module boundaries and GL010 checks the fault-site registry
-    against every consultation site and the chaos-test tree.  Explicit
+    (and the r20 mesh-axis closure) crosses module boundaries, GL010
+    checks the fault-site registry against every consultation site and
+    the chaos-test tree, and GL014 pins PARITY.md's bit-identical/
+    tolerance contracts to live (file, symbol) anchors.  Explicit
     paths keep the r8 per-file behavior (fixtures, CLI-on-a-file) —
     cross-module rules need the whole program and are skipped there.
 
@@ -96,6 +98,8 @@ def run_lint(paths: Optional[Iterable[str]] = None,
         test_sources = (_read_sources([tests_dir])
                         if os.path.isdir(tests_dir) else [])
         report.findings.extend(fault_site_findings(program, test_sources))
+        # GL014: PARITY.md contracts pinned to live (file, symbol) pairs
+        report.findings.extend(parity_anchor_findings(REPO_ROOT))
         report.files_checked = len(modules)
     else:
         for rel, src in _read_sources(paths):

@@ -28,6 +28,17 @@ three directions:
 3. every registered site is referenced from at least one test module
    (the chaos matrix must not silently stop covering a site).
 
+r20 adds two whole-program families on the same chassis.  GL012's
+mesh-context closure rides the exact machinery above: ``shard_map``
+references seed meshed functions the way tracing calls seed traced
+ones, meshed callers propagate their axis sets across modules, and an
+``axis_resolver`` installed per entry lets ``lax.psum(x, DATA_AXIS)``
+resolve ``DATA_AXIS`` through the import table to the defining
+module's string constant.  GL014 (:func:`parity_anchor_findings`) pins
+every bit-identical/tolerance claim in PARITY.md to live ``(file,
+symbol)`` pairs — the budgets-layer ``BUDGET_ANCHORS`` discipline,
+applied to parity contracts.
+
 Like the rest of Layer 1 this is pure ``ast`` — nothing here imports
 JAX or even the package under analysis.
 """
@@ -35,7 +46,9 @@ JAX or even the package under analysis.
 from __future__ import annotations
 
 import ast
+import re
 from dataclasses import dataclass, field
+from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .rules import (Finding, _ModuleAnalysis, apply_waivers, is_kernel_file)
@@ -127,7 +140,24 @@ class Program:
                 entry.resolve_imports()
             self.entries.append(entry)
             self.by_module[modname] = entry
+        # GL012: let each module resolve imported axis constants
+        # (``from ..parallel.data_parallel import DATA_AXIS``) before the
+        # mesh sites are seeded inside the first close_local round
+        for e in self.entries:
+            if e.analysis is not None:
+                e.analysis.axis_resolver = self._axis_resolver_for(e)
         self._close()
+
+    def _axis_resolver_for(self, entry: ModuleEntry):
+        def resolve(name: str) -> Optional[str]:
+            hit = entry.symbol_imports.get(name)
+            if hit is None:
+                return None
+            target = self.by_module.get(hit[0])
+            if target is None or target.analysis is None:
+                return None
+            return target.analysis.str_constants.get(hit[1])
+        return resolve
 
     # -- cross-module traced/kernel closure ---------------------------------
     def _resolve_chain(self, entry: ModuleEntry,
@@ -181,27 +211,33 @@ class Program:
                         if target.analysis is not None and \
                                 target.analysis.seed_traced(sym, kern):
                             changed = True
-                # callees of traced functions
-                for info in a.funcs:
-                    if not info.traced:
-                        continue
-                    for callee in info.calls:
-                        hit = self._resolve_chain(e, (callee,))
-                        if hit is None:
-                            continue
+                # GL012: references inside mesh-entry arguments
+                for chain, axes, complete in a.external_mesh_refs:
+                    hit = self._resolve_chain(e, chain)
+                    if hit is not None:
                         target, sym = hit
                         if target.analysis is not None and \
-                                target.analysis.seed_traced(
-                                    sym, info.kernel):
+                                target.analysis.seed_meshed(
+                                    sym, axes, complete):
                             changed = True
-                    for chain in info.attr_calls:
+                # callees of traced/meshed functions
+                for info in a.funcs:
+                    if not (info.traced or info.meshed):
+                        continue
+                    for chain in [(c,) for c in info.calls] + \
+                            list(info.attr_calls):
                         hit = self._resolve_chain(e, chain)
                         if hit is None:
                             continue
                         target, sym = hit
-                        if target.analysis is not None and \
-                                target.analysis.seed_traced(
-                                    sym, info.kernel):
+                        if target.analysis is None:
+                            continue
+                        if info.traced and target.analysis.seed_traced(
+                                sym, info.kernel):
+                            changed = True
+                        if info.meshed and target.analysis.seed_meshed(
+                                sym, info.mesh_axes,
+                                not info.mesh_unknown):
                             changed = True
             if changed:
                 for e in self.entries:
@@ -364,4 +400,161 @@ def fault_site_findings(
                     f"registered fault site {site!r} is not referenced "
                     f"by any chaos/resilience test — the chaos matrix "
                     f"silently stopped covering it"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GL014 — parity-contract anchors
+# ---------------------------------------------------------------------------
+# Every PARITY.md section that makes a bit-identical / tolerance claim
+# must be pinned to the live code and tests that carry the claim — the
+# BUDGET_ANCHORS discipline (analysis/budgets.py), applied to parity
+# contracts.  Keys are PARITY.md `## ` heading texts, values are
+# (repo-relative file, top-level symbol) pairs.  Renaming or deleting a
+# pinned symbol fails the lint NAMING the stale contract, so the doc
+# and the code cannot drift apart silently.
+PARITY_DOC = "PARITY.md"
+_PARITY_CLAIM_RE = re.compile(r"bit-?identical|bitwise|tolerance", re.I)
+
+PARITY_ANCHORS: Dict[str, Tuple[Tuple[str, str], ...]] = {
+    "Quantized-threshold comparison rule (r18 serving)": (
+        ("lightgbm_tpu/ops/predict.py", "ForestSoA"),
+        ("lightgbm_tpu/ops/predict.py", "pack_forest_soa"),
+        ("lightgbm_tpu/ops/predict.py", "predict_forest_pallas"),
+        ("lightgbm_tpu/ops/quantize.py", "ThresholdBoundError"),
+        ("tests/test_predict_fused.py", "test_bin_edge_routes_left"),
+        ("tests/test_predict_fused.py",
+         "test_threshold_bound_rejected_at_ingest"),
+        ("tests/test_predict_fused.py", "test_runtime_oracle_parity"),
+    ),
+    "Streamed-dp parity rule: bit-identical vs tolerance-gated (r19)": (
+        ("lightgbm_tpu/data/stream_dp.py", "stream_dp_grow_tree"),
+        ("lightgbm_tpu/ops/histogram.py", "histogram_merge"),
+        ("lightgbm_tpu/ops/quantize.py", "wire_transfer"),
+        ("tests/test_stream_dp.py",
+         "test_stream_dp_bit_identical_where_exact"),
+        ("tests/test_stream_dp.py",
+         "test_stream_dp_general_data_dp_parity_bar"),
+        ("tests/test_stream_dp.py",
+         "test_elastic_resume_first_round_bit_identical_across_d"),
+    ),
+}
+
+
+def _top_level_symbols(path: Path) -> Optional[Set[str]]:
+    """Top-level def/class/assignment names of a module; None when the
+    file is missing or does not parse (the caller reports that as the
+    stale-anchor finding, not a crash)."""
+    try:
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+    except (OSError, SyntaxError):
+        return None
+    out: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            out.add(node.name)
+        elif isinstance(node, ast.Assign):
+            out |= {t.id for t in node.targets if isinstance(t, ast.Name)}
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            out.add(node.target.id)
+    return out
+
+
+def _parity_sections(text: str) -> Dict[str, Tuple[int, str]]:
+    """``## `` heading -> (1-based heading line, section body)."""
+    sections: Dict[str, Tuple[int, str]] = {}
+    title: Optional[str] = None
+    start = 0
+    body: List[str] = []
+    for i, line in enumerate(text.splitlines(), start=1):
+        if line.startswith("## "):
+            if title is not None:
+                sections[title] = (start, "\n".join(body))
+            title = line[3:].strip()
+            start = i
+            body = []
+        elif title is not None:
+            body.append(line)
+    if title is not None:
+        sections[title] = (start, "\n".join(body))
+    return sections
+
+
+def parity_anchor_findings(
+        repo_root: Path,
+        anchors: Optional[Dict[str, Tuple[Tuple[str, str], ...]]] = None,
+        parity_md: Optional[str] = None) -> List[Finding]:
+    """GL014: PARITY.md contracts <-> live code, both directions.
+
+    1. every claim-bearing section (matches ``bit-identical``/
+       ``bitwise``/``tolerance``) has a PARITY_ANCHORS entry;
+    2. every PARITY_ANCHORS key names a section that still exists;
+    3. every pinned (file, symbol) resolves to a live top-level symbol.
+
+    ``anchors``/``parity_md`` are injectable for tests; the default pass
+    reads ``PARITY_ANCHORS`` and ``<repo_root>/PARITY.md``.
+    """
+    if anchors is None:
+        anchors = PARITY_ANCHORS
+    if parity_md is None:
+        doc = Path(repo_root) / PARITY_DOC
+        if not doc.is_file():
+            if anchors:
+                return [Finding(
+                    "GL014", PARITY_DOC, 1, 0,
+                    f"{len(anchors)} parity contract(s) are anchored but "
+                    f"{PARITY_DOC} is missing — the contract document "
+                    f"moved or was deleted without retiring its anchors")]
+            return []
+        parity_md = doc.read_text(encoding="utf-8")
+
+    findings: List[Finding] = []
+    sections = _parity_sections(parity_md)
+
+    for title, (line, body) in sorted(sections.items(),
+                                      key=lambda kv: kv[1][0]):
+        # a CLAIM is prose (or the heading itself) — markdown table rows
+        # are feature inventories, not parity contracts
+        prose = "\n".join(ln for ln in body.splitlines()
+                          if not ln.lstrip().startswith("|"))
+        if _PARITY_CLAIM_RE.search(title) or _PARITY_CLAIM_RE.search(prose):
+            if title not in anchors:
+                findings.append(Finding(
+                    "GL014", PARITY_DOC, line, 0,
+                    f"section {title!r} makes a bit-identical/tolerance "
+                    f"claim but has no PARITY_ANCHORS entry — pin the "
+                    f"claim to its (file, symbol) pairs in "
+                    f"analysis/program.py so renames fail the lint"))
+
+    symcache: Dict[str, Optional[Set[str]]] = {}
+    for title in sorted(anchors):
+        if title not in sections:
+            findings.append(Finding(
+                "GL014", PARITY_DOC, 1, 0,
+                f"PARITY_ANCHORS pins section {title!r} but {PARITY_DOC} "
+                f"has no such heading — the contract was renamed or "
+                f"removed; update the anchor key (analysis/program.py) "
+                f"in the same change"))
+            continue
+        line = sections[title][0]
+        for rel, sym in anchors[title]:
+            if rel not in symcache:
+                symcache[rel] = _top_level_symbols(Path(repo_root) / rel)
+            syms = symcache[rel]
+            if syms is None:
+                findings.append(Finding(
+                    "GL014", PARITY_DOC, line, 0,
+                    f"contract {title!r} is anchored to {rel} which is "
+                    f"missing or unparseable — the parity-bearing module "
+                    f"moved; re-pin the contract"))
+            elif sym not in syms:
+                findings.append(Finding(
+                    "GL014", PARITY_DOC, line, 0,
+                    f"contract {title!r} is anchored to {rel}:{sym} "
+                    f"which no longer exists at top level — the "
+                    f"parity-bearing symbol was renamed or deleted; "
+                    f"update the contract and its anchor together"))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return findings

@@ -31,6 +31,36 @@ outside ``with self._lock``), GL010 fault-site registry drift (lives in
 :mod:`.program` — it is whole-program by nature), and GL011 typed-error
 discipline (bare ``except:``, ``raise Exception``, swallowed handlers).
 
+Mesh-context model (r20)
+------------------------
+GL012 runs a second closure in parallel with the traced one: a function
+is *meshed* when a ``shard_map``/``pmap`` entry point reaches it — its
+name appears in a mesh entry call's arguments, it is lexically nested in
+a meshed function, or a meshed function calls it (cross-module through
+:mod:`.program`, exactly like tracing).  Each meshed function carries
+the union of axis names its seeding sites establish (string literals in
+``P(...)``/``PartitionSpec(...)`` specs and ``axis_name=`` kwargs,
+resolved through module string constants and, whole-program, through
+imports); sites whose axes cannot be statically resolved mark the
+context *incomplete*, which disables the axis-agreement check but keeps
+the membership fact.  A collective whose axis argument is a function
+PARAMETER is never an outside-mesh finding — the axis flows from the
+caller and the mesh closure checks the caller instead.
+
+Quantized-space lattice (r20)
+-----------------------------
+GL013 runs a per-function abstract-space inference over three value
+spaces the r14/r18/r19 rounds made load-bearing: ``bin`` (u8 bin codes
+— ordinal, compared but never measured), ``int8``/``bf16`` (quantized
+wire payloads), and ``stat`` (f32 statistics / dequantized values).
+Spaces seed from explicit casts (``.astype(jnp.uint8)`` -> bin,
+``.astype(jnp.int8/bfloat16)`` -> wire, ``.astype(jnp.float32)`` ->
+stat, i.e. a dequantize) and from the ``ForestSoA``/``PackedForest``/
+``QuantizedForestArrays`` layout-contract fields (``.split_bin`` ->
+bin, ``.leaf_q`` -> wire), and propagate through assignment, slicing,
+shape ops and ``jnp.where``.  Unknown stays unknown — every GL013
+sub-rule fires only on proven mixes.
+
 See analysis/RULES.md for one bad/good example per rule.
 """
 
@@ -70,6 +100,48 @@ HOST_CONSTANT_JAX_CALLS = {
 }
 
 KERNEL_DOT_CALLS = {"dot_general", "dot", "matmul", "einsum"}
+
+# -- GL012: collective/mesh discipline -------------------------------------
+# Cross-replica collectives: every one of these requires a bound mesh
+# axis at trace time.  ``lax.axis_index`` is deliberately EXCLUDED — it
+# needs the axis too, but every workbench use sits next to a collective
+# that already carries the finding, and flagging both doubles the noise
+# for one bug.
+COLLECTIVE_CALLS = {
+    "psum", "psum_scatter", "ppermute", "all_gather", "all_to_all",
+    "pmean", "pmax", "pmin", "pshuffle", "pswapaxes",
+}
+# tracing calls that ESTABLISH a mesh-axis context for their function
+# argument (vmap/scan etc. trace but bind no axis)
+MESH_ENTRY_CALLS = {"shard_map", "pmap", "xmap"}
+PARTITION_SPEC_NAMES = {"P", "PartitionSpec"}
+
+# -- GL013: quantized-space lattice -----------------------------------------
+# Layout-contract fields whose space is part of the serving/wire ABI
+# (ForestSoA / PackedForest / QuantizedForestArrays — see PARITY.md).
+BIN_CODE_FIELDS = {"split_bin"}          # u8 bin codes: ordinal, not metric
+WIRE_FIELDS = {"leaf_q"}                 # quantized wire payloads
+_CAST_SPACE = {
+    "uint8": "bin",
+    "int8": "int8",
+    "bfloat16": "bf16",
+    "float32": "stat",                   # an f32 cast IS the dequantize
+    "float64": "stat",
+}
+WIRE_SPACES = {"int8", "bf16"}
+# methods that change shape/residency but never the value space
+_SPACE_PRESERVING_METHODS = {
+    "reshape", "ravel", "flatten", "copy", "transpose", "squeeze",
+    "block_until_ready",
+}
+# the int8 histogram accumulator overflows int32 past this many rows
+# (all-ones gradient column: 127 * n  >  2^31 - 1)
+INT8_ACC_ROW_LIMIT = (1 << 31) // 127    # = 16_909_320
+# the ONE sanctioned raw-wire boundary: ops/quantize.py's per-hop
+# requantize helper (and its leading-underscore alias in older call
+# sites) may ppermute int8/bf16 payloads — everything else must route
+# hops through it
+SANCTIONED_HOP_FUNCS = {"wire_transfer", "_wire_transfer"}
 
 # -- GL008: determinism --------------------------------------------------
 # ``time`` module calls that read (or stall on) the wall clock.  A bare
@@ -209,6 +281,12 @@ class _FuncInfo:
     # dotted callees (('mod', 'f') for mod.f(...)) — resolved across
     # module boundaries by analysis.program in whole-program mode
     attr_calls: Set[Tuple[str, ...]] = field(default_factory=set)
+    # -- GL012 mesh-context closure (parallel to traced) --
+    meshed: bool = False                # reachable from a mesh entry point
+    mesh_axes: Set[str] = field(default_factory=set)
+    # True when ANY seeding site's axes could not be statically resolved
+    # — membership holds, but the axis-agreement check is disabled
+    mesh_unknown: bool = False
 
     def body_stmts(self) -> List[ast.AST]:
         if isinstance(self.node, ast.Lambda):
@@ -220,6 +298,32 @@ class _FuncInfo:
         for stmt in self.body_stmts():
             yield stmt
             yield from _ordered_walk(stmt)
+
+    def strict_own_nodes(self) -> Iterator[ast.AST]:
+        """Like own_nodes, but DIRECTLY-nested def statements are skipped
+        too (own_nodes yields them and walks their bodies).  The r20
+        rules need true per-function ownership: a collective inside a
+        nested shard_map body belongs to the nested function's info —
+        attributing it to the enclosing (unmeshed) function would turn
+        the standard closure idiom into a false positive."""
+        for stmt in self.body_stmts():
+            if isinstance(stmt, _FUNC_NODES):
+                continue
+            yield stmt
+            yield from _ordered_walk(stmt)
+
+
+@dataclass
+class _MeshSite:
+    """One ``shard_map``/``pmap`` call: the names/chains it references and
+    the axes its specs establish.  Seeding is deferred to ``close_local``
+    so whole-program mode can install an ``axis_resolver`` first."""
+    call: ast.Call
+    names: Set[str]                     # bare names in the call's args
+    chains: Set[Tuple[str, ...]]        # dotted refs for cross-module seeds
+    axes: Set[str]                      # statically-resolved axis names
+    deferred: Set[str]                  # axis NAMES awaiting the resolver
+    has_specs: bool                     # any P(...)/axis_name= seen at all
 
 
 class _Scoper(ast.NodeVisitor):
@@ -287,6 +391,25 @@ class _ModuleAnalysis:
         # not resolve to a local def — candidates for cross-module
         # traced roots, resolved by analysis.program
         self.external_traced_refs: List[Tuple[Tuple[str, ...], bool]] = []
+        # -- GL012 state --
+        # module-level  NAME = "string"  constants (DATA_AXIS = "data")
+        self.str_constants: Dict[str, str] = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                self.str_constants[node.targets[0].id] = node.value.value
+        # mesh entry sites awaiting seeding (see _MeshSite)
+        self.mesh_sites: List[_MeshSite] = []
+        # (chain, axes, complete) mesh refs that did not resolve locally
+        self.external_mesh_refs: List[
+            Tuple[Tuple[str, ...], frozenset, bool]] = []
+        # whole-program mode installs a callable(name)->Optional[str]
+        # that resolves imported axis constants; None = per-file mode
+        self.axis_resolver = None
+        self._mesh_seeded = False
+        self._int8_guard: Optional[bool] = None
         # local binding -> imported module ('np' -> 'numpy'); and
         # local binding -> (module, symbol) for from-imports
         self.import_aliases: Dict[str, str] = {}
@@ -322,6 +445,30 @@ class _ModuleAnalysis:
                 info.traced = True
                 info.kernel = info.kernel or kernel
                 changed = True
+        return changed
+
+    @staticmethod
+    def _merge_mesh(info: _FuncInfo, axes, complete: bool) -> bool:
+        """Union a mesh context into one function; True if it grew."""
+        changed = False
+        if not info.meshed:
+            info.meshed = True
+            changed = True
+        new = set(axes) - info.mesh_axes
+        if new:
+            info.mesh_axes |= new
+            changed = True
+        if not complete and not info.mesh_unknown:
+            info.mesh_unknown = True
+            changed = True
+        return changed
+
+    def seed_meshed(self, name: str, axes, complete: bool = True) -> bool:
+        """Mark every local def called ``name`` mesh-reachable with the
+        given axes (cross-module propagation entry point)."""
+        changed = False
+        for info in self.by_name.get(name, []):
+            changed |= self._merge_mesh(info, axes, complete)
         return changed
 
     # -- traced/kernel closure ----------------------------------------------
@@ -382,19 +529,111 @@ class _ModuleAnalysis:
                         info.static_params |= statics
             # dotted references (mod.helper) never resolve locally —
             # hand them to the whole-program resolver
+            chains: Set[Tuple[str, ...]] = set()
             for a in list(call.args) + [kw.value for kw in call.keywords]:
                 for sub in ast.walk(a):
                     if isinstance(sub, ast.Attribute):
                         ch = _attr_chain(sub)
                         if 2 <= len(ch) <= 4:
+                            chains.add(tuple(ch))
                             self.external_traced_refs.append(
                                 (tuple(ch), tgt == "pallas_call"))
+            # mesh entry points additionally establish an axis context
+            # for everything they reference (GL012) — recorded now,
+            # seeded in close_local once the axis_resolver is in place
+            if tgt in MESH_ENTRY_CALLS:
+                axes, deferred, has_specs = self._mesh_axes_of(call)
+                self.mesh_sites.append(_MeshSite(
+                    call=call, names=set(referenced), chains=chains,
+                    axes=axes, deferred=deferred, has_specs=has_specs))
+
+    # -- GL012: mesh-axis extraction ------------------------------------------
+    def _collect_axis(self, node: ast.AST, axes: Set[str],
+                      deferred: Set[str]) -> None:
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                self._collect_axis(e, axes, deferred)
+        elif isinstance(node, ast.Constant):
+            if isinstance(node.value, str):
+                axes.add(node.value)
+            # P(None) / P() placeholders carry no axis
+        elif isinstance(node, ast.Name):
+            if node.id in self.str_constants:
+                axes.add(self.str_constants[node.id])
+            else:
+                deferred.add(node.id)
+        else:
+            # smesh.axis_name, f-strings, ... — not statically resolvable
+            deferred.add("?")
+
+    def _mesh_axes_of(self, call: ast.Call
+                      ) -> Tuple[Set[str], Set[str], bool]:
+        """Axis names a mesh entry call establishes: string literals (or
+        resolvable module constants) inside P(...)/PartitionSpec(...)
+        specs and axis_name= kwargs.  ``deferred`` holds names the
+        whole-program resolver may still supply; the marker '?' means an
+        expression form no resolver can recover."""
+        axes: Set[str] = set()
+        deferred: Set[str] = set()
+        has_specs = False
+        for kw in call.keywords:
+            if kw.arg in ("axis_name", "axis_names"):
+                has_specs = True
+                self._collect_axis(kw.value, axes, deferred)
+        for node in ast.walk(call):
+            if isinstance(node, ast.Call) and node is not call:
+                t2, _ = _call_target(node)
+                if t2 in PARTITION_SPEC_NAMES:
+                    has_specs = True
+                    for a in node.args:
+                        self._collect_axis(a, axes, deferred)
+        return axes, deferred, has_specs
+
+    def seed_mesh_sites(self) -> None:
+        """Turn recorded mesh entry sites into meshed functions, resolving
+        deferred axis names through ``axis_resolver`` when whole-program
+        mode installed one.  Idempotent; runs at the top of close_local."""
+        if self._mesh_seeded:
+            return
+        self._mesh_seeded = True
+        for site in self.mesh_sites:
+            axes = set(site.axes)
+            unresolved: Set[str] = set()
+            for name in site.deferred:
+                val = (self.axis_resolver(name)
+                       if self.axis_resolver and name != "?" else None)
+                if val is not None:
+                    axes.add(val)
+                else:
+                    unresolved.add(name)
+            complete = site.has_specs and not unresolved
+            for name in site.names:
+                if name in self.by_name:
+                    self.seed_meshed(name, axes, complete)
+                else:
+                    self.external_mesh_refs.append(
+                        ((name,), frozenset(axes), complete))
+            for ch in site.chains:
+                self.external_mesh_refs.append(
+                    (ch, frozenset(axes), complete))
+            # inline lambdas (shard_map(lambda x: ..., ...)) have no
+            # name to seed through — mesh them by node identity
+            lambda_nodes = {id(sub)
+                            for a in list(site.call.args)
+                            + [kw.value for kw in site.call.keywords]
+                            for sub in ast.walk(a)
+                            if isinstance(sub, ast.Lambda)}
+            if lambda_nodes:
+                for info in self.funcs:
+                    if id(info.node) in lambda_nodes:
+                        self._merge_mesh(info, axes, complete)
 
     def close_local(self) -> bool:
         """Lexical nesting + intra-module call graph, to a local fixed
         point.  Returns whether anything changed — analysis.program
         re-runs this after each cross-module seeding round, so the
         global closure is a fixed point over all modules."""
+        self.seed_mesh_sites()
         any_change = False
         changed = True
         while changed:
@@ -412,6 +651,17 @@ class _ModuleAnalysis:
                                 ci.traced = True
                                 ci.kernel = ci.kernel or info.kernel
                                 changed = True
+                # GL012: the mesh context flows exactly like tracing —
+                # lexical nesting and plain Python calls
+                if info.parent is not None and info.parent.meshed:
+                    changed |= self._merge_mesh(
+                        info, info.parent.mesh_axes,
+                        not info.parent.mesh_unknown)
+                if info.meshed:
+                    for callee in info.calls:
+                        for ci in self.by_name.get(callee, []):
+                            changed |= self._merge_mesh(
+                                ci, info.mesh_axes, not info.mesh_unknown)
             any_change = any_change or changed
         return any_change
 
@@ -439,9 +689,13 @@ class _ModuleAnalysis:
                 self._rule_host_sync(info)
             if info.kernel:
                 self._rule_kernel_dot(info)
+            if info.traced or info.meshed:
+                self._rule_collective_balance(info)
             self._rule_static_args(info)
             self._rule_inplace_mutation(info)
             self._rule_donate_reuse(info)
+            self._rule_mesh_collectives(info)
+            self._rule_quantized_space(info)
         self._rule_static_args_callsites()
         self._rule_host_sync_global()
         self._rule_f64()
@@ -956,9 +1210,422 @@ class _ModuleAnalysis:
                         f"contract — raise one of the workbench's typed "
                         f"faults so callers can catch precisely")
 
+    # -- GL012: collective/mesh discipline ------------------------------------
+    def _collective_call(self, call: ast.Call) -> Optional[str]:
+        """The collective's name when this call is a jax.lax collective,
+        else None.  Requires a jax-rooted callee — a method named `psum`
+        on some service object never matches."""
+        tgt, chain = _call_target(call)
+        if tgt not in COLLECTIVE_CALLS:
+            return None
+        if len(chain) == 1:
+            mod = self.from_imports.get(tgt, ("", ""))[0]
+            return tgt if mod in ("jax.lax", "lax") else None
+        root = chain[0]
+        if root in ("lax", "jax"):
+            return tgt
+        if self.from_imports.get(root) == ("jax", "lax"):
+            return tgt
+        if self.import_aliases.get(root, "").split(".")[0] == "jax":
+            return tgt
+        return None
+
+    def _collective_axis(self, call: ast.Call, info: _FuncInfo
+                         ) -> Tuple[str, Optional[str]]:
+        """Classify a collective's axis argument:
+        ('const', name)   — string literal / module string constant
+        ('param', name)   — a formal parameter of this or an enclosing
+                            function (the caller owns the binding)
+        ('unknown', None) — any other expression form"""
+        axis_node: Optional[ast.AST] = None
+        for kw in call.keywords:
+            if kw.arg == "axis_name":
+                axis_node = kw.value
+        if axis_node is None and len(call.args) >= 2:
+            axis_node = call.args[1]
+        if axis_node is None:
+            return "unknown", None
+        if isinstance(axis_node, ast.Constant) and \
+                isinstance(axis_node.value, str):
+            return "const", axis_node.value
+        if isinstance(axis_node, ast.Name):
+            name = axis_node.id
+            if name in self.str_constants:
+                return "const", self.str_constants[name]
+            if self.axis_resolver is not None:
+                val = self.axis_resolver(name)
+                if val is not None:
+                    return "const", val
+            cur: Optional[_FuncInfo] = info
+            while cur is not None:
+                if name in cur.params:
+                    return "param", name
+                cur = cur.parent
+        return "unknown", None
+
+    def _rule_mesh_collectives(self, info: _FuncInfo) -> None:
+        for node in info.strict_own_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            coll = self._collective_call(node)
+            if coll is None:
+                continue
+            kind, axis = self._collective_axis(node, info)
+            if not info.meshed:
+                # a parameter axis flows from the caller — the closure
+                # checks the caller instead, so only LITERAL axes can be
+                # proven unbound here
+                if kind == "const":
+                    where = f"`{info.name}`" if info.name else "a lambda"
+                    self.emit(
+                        "GL012", node,
+                        f"lax.{coll} over axis {axis!r} in {where}, which "
+                        f"no shard_map/pmap entry point reaches — the "
+                        f"axis is unbound at trace time (tracing raises, "
+                        f"or a stubbed mesh silently no-ops the "
+                        f"reduction); establish the mesh context or "
+                        f"accept axis_name from the caller")
+                continue
+            if kind == "const" and not info.mesh_unknown and \
+                    info.mesh_axes and axis not in info.mesh_axes:
+                known = ", ".join(repr(a) for a in sorted(info.mesh_axes))
+                self.emit(
+                    "GL012", node,
+                    f"lax.{coll} names axis {axis!r} but the enclosing "
+                    f"mesh context binds only {known} — the collective "
+                    f"raises an unbound-axis error at trace time (or "
+                    f"reduces over the wrong replica group if {axis!r} "
+                    f"exists on an outer mesh)")
+
+    def _count_collectives(self, nodes) -> int:
+        return sum(1 for n in nodes
+                   if isinstance(n, ast.Call)
+                   and self._collective_call(n) is not None)
+
+    def _branch_collective_count(self, branch: ast.AST) -> Optional[int]:
+        """Collectives a lax.cond/switch branch performs; None when the
+        branch cannot be resolved statically (partial(...), methods,
+        multiply-defined names)."""
+        if isinstance(branch, ast.Lambda):
+            return self._count_collectives(ast.walk(branch.body))
+        if isinstance(branch, ast.Name):
+            infos = self.by_name.get(branch.id, [])
+            if len(infos) == 1:
+                return self._count_collectives(infos[0].strict_own_nodes())
+        return None
+
+    def _stmt_collective_count(self, stmts) -> int:
+        c = 0
+        for s in stmts:
+            c += self._count_collectives([s, *_ordered_walk(s)])
+        return c
+
+    def _rule_collective_balance(self, info: _FuncInfo) -> None:
+        """The SPMD deadlock shape: under a traced/meshed program, one
+        branch of a conditional performs a collective the other doesn't.
+        Replicas that disagree on the predicate (or a traced predicate
+        lowered per-shard) leave some devices waiting in the collective
+        forever.  Host-static Python `if`s (config flags, `axis_name is
+        None` dispatch) are exempt — only traced-value tests count."""
+        for node in info.strict_own_nodes():
+            if isinstance(node, ast.Call):
+                tgt, chain = _call_target(node)
+                if not chain or chain[0] not in ("lax", "jax"):
+                    continue
+                branches: List[ast.AST] = []
+                if tgt == "cond" and len(node.args) >= 3:
+                    branches = list(node.args[1:3])
+                elif tgt == "switch" and len(node.args) >= 2 and \
+                        isinstance(node.args[1], (ast.List, ast.Tuple)):
+                    branches = list(node.args[1].elts)
+                if len(branches) < 2:
+                    continue
+                counts = [self._branch_collective_count(b)
+                          for b in branches]
+                if any(c is None for c in counts):
+                    continue
+                if any(c > 0 for c in counts) and \
+                        any(c == 0 for c in counts):
+                    self.emit(
+                        "GL012", node,
+                        f"lax.{tgt} where one branch performs a "
+                        f"collective and another performs none — under "
+                        f"SPMD every replica must reach the same "
+                        f"collective sequence, so the no-collective "
+                        f"branch deadlocks the mesh; hoist the "
+                        f"collective out of the conditional or make "
+                        f"every branch participate (psum of zeros)")
+            elif isinstance(node, ast.If) and node.orelse:
+                if not self._tests_traced_value(node.test):
+                    continue
+                nb = self._stmt_collective_count(node.body)
+                ne = self._stmt_collective_count(node.orelse)
+                if (nb > 0) != (ne > 0):
+                    self.emit(
+                        "GL012", node,
+                        "`if` on a traced value where only one arm "
+                        "performs a collective — per-shard divergence "
+                        "deadlocks the mesh (the arm without the "
+                        "collective never posts the matching reduction); "
+                        "make both arms participate or hoist the "
+                        "predicate to trace time")
+
+    def _tests_traced_value(self, test: ast.AST) -> bool:
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Call):
+                tgt, chain = _call_target(sub)
+                if tgt in HOST_CONSTANT_JAX_CALLS:
+                    continue
+                if chain and chain[0] in JAX_EXPR_ROOTS:
+                    return True
+        return False
+
+    # -- GL013: quantized-space discipline -------------------------------------
+    def _dtype_name_of(self, node: ast.AST) -> Optional[str]:
+        chain = _attr_chain(node)
+        if chain and chain[-1] in _CAST_SPACE:
+            return chain[-1]
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value if node.value in _CAST_SPACE else None
+        return None
+
+    def _space_of(self, expr: ast.AST,
+                  env: Dict[str, Optional[str]]) -> Optional[str]:
+        """Abstract value space of an expression: 'bin' | 'int8' | 'bf16'
+        | 'stat' | None (unknown).  Deliberately conservative — unknown
+        propagates, so every GL013 finding rests on a PROVEN mix."""
+        if isinstance(expr, ast.Name):
+            return env.get(expr.id)
+        if isinstance(expr, (ast.Subscript, ast.Starred)):
+            return self._space_of(expr.value, env)
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in BIN_CODE_FIELDS:
+                return "bin"
+            if expr.attr in WIRE_FIELDS:
+                return "int8"
+            if expr.attr == "T":
+                return self._space_of(expr.value, env)
+            return None
+        if isinstance(expr, ast.Call):
+            tgt, chain = _call_target(expr)
+            if isinstance(expr.func, ast.Attribute):
+                recv = expr.func.value
+                if tgt == "astype" and expr.args:
+                    d = self._dtype_name_of(expr.args[0])
+                    if d is not None:
+                        return _CAST_SPACE[d]
+                    return self._space_of(recv, env)  # width-only change
+                if tgt in _SPACE_PRESERVING_METHODS:
+                    return self._space_of(recv, env)
+            if tgt == "where" and chain and chain[0] in JAX_EXPR_ROOTS \
+                    and len(expr.args) == 3:
+                a = self._space_of(expr.args[1], env)
+                b = self._space_of(expr.args[2], env)
+                return a if a == b else None
+            return None
+        if isinstance(expr, ast.BinOp):
+            left = self._space_of(expr.left, env)
+            right = self._space_of(expr.right, env)
+            if left == right:
+                return left
+            # f32 is absorbing under JAX promotion: stat * scale -> stat
+            if "stat" in (left, right):
+                return "stat"
+            return None
+        if isinstance(expr, ast.UnaryOp):
+            return self._space_of(expr.operand, env)
+        return None
+
+    @staticmethod
+    def _is_float_literal(node: ast.AST) -> bool:
+        if isinstance(node, ast.UnaryOp):
+            node = node.operand
+        return isinstance(node, ast.Constant) and \
+            isinstance(node.value, float)
+
+    def _module_has_int8_guard(self) -> bool:
+        """Any comparison in this MODULE against the 2^31/127 bound —
+        a literal 16_909_320, a name like INT8_ACC_ROW_LIMIT, or the
+        expression (1 << 31) // 127 — counts as the row-count guard."""
+        if self._int8_guard is None:
+            self._int8_guard = any(
+                isinstance(node, ast.Compare)
+                and any(self._is_int8_bound(op)
+                        for op in [node.left, *node.comparators])
+                for node in ast.walk(self.tree))
+        return self._int8_guard
+
+    @staticmethod
+    def _is_int8_bound(node: ast.AST) -> bool:
+        if isinstance(node, ast.Constant):
+            return node.value == INT8_ACC_ROW_LIMIT
+        chain = _attr_chain(node)
+        if chain:
+            leaf = chain[-1].upper()
+            return "INT8" in leaf and ("LIMIT" in leaf or "BOUND" in leaf)
+        if isinstance(node, ast.BinOp) and \
+                isinstance(node.op, ast.FloorDiv) and \
+                isinstance(node.right, ast.Constant) and \
+                node.right.value == 127:
+            lhs = node.left
+            return (isinstance(lhs, ast.BinOp)
+                    and ((isinstance(lhs.op, ast.LShift)
+                          and isinstance(lhs.right, ast.Constant)
+                          and lhs.right.value == 31)
+                         or (isinstance(lhs.op, ast.Pow)
+                             and isinstance(lhs.right, ast.Constant)
+                             and lhs.right.value == 31)))
+        return False
+
+    def _assigns_int32(self, info: _FuncInfo, name: str) -> bool:
+        """Does any assignment in this function bind `name` to an int32
+        dtype?  Handles tuple unpacking (`oh_t, acc_t = jnp.int8,
+        jnp.int32`)."""
+        for node in info.strict_own_nodes():
+            if not isinstance(node, ast.Assign):
+                continue
+            for target in node.targets:
+                pairs = []
+                if isinstance(target, ast.Name):
+                    pairs = [(target, node.value)]
+                elif isinstance(target, (ast.Tuple, ast.List)) and \
+                        isinstance(node.value, (ast.Tuple, ast.List)) and \
+                        len(target.elts) == len(node.value.elts):
+                    pairs = list(zip(target.elts, node.value.elts))
+                for t, v in pairs:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        ch = _attr_chain(v)
+                        if ch and ch[-1] == "int32":
+                            return True
+        return False
+
+    def _int8_accumulation(self, call: ast.Call, info: _FuncInfo,
+                           env: Dict[str, Optional[str]]) -> bool:
+        tgt, chain = _call_target(call)
+        if tgt in KERNEL_DOT_CALLS and chain and \
+                chain[0] in ("lax", "jnp", "jax"):
+            for kw in call.keywords:
+                if kw.arg != "preferred_element_type":
+                    continue
+                ch = _attr_chain(kw.value)
+                if ch and ch[-1] == "int32":
+                    return True
+                if isinstance(kw.value, ast.Name) and \
+                        self._assigns_int32(info, kw.value.id):
+                    return True
+            return False
+        if tgt == "sum" and chain and chain[0] == "jnp" and call.args:
+            return self._space_of(call.args[0], env) == "int8"
+        return False
+
+    def _in_sanctioned_hop(self, info: _FuncInfo) -> bool:
+        cur: Optional[_FuncInfo] = info
+        while cur is not None:
+            if cur.name in SANCTIONED_HOP_FUNCS:
+                return True
+            cur = cur.parent
+        return False
+
+    def _rule_quantized_space(self, info: _FuncInfo) -> None:
+        env: Dict[str, Optional[str]] = {}
+        for node in info.strict_own_nodes():
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                env[node.targets[0].id] = self._space_of(node.value, env)
+            operands: List[ast.AST] = []
+            if isinstance(node, ast.Compare):
+                if any(isinstance(op, (ast.Is, ast.IsNot, ast.In,
+                                       ast.NotIn)) for op in node.ops):
+                    operands = []
+                else:
+                    operands = [node.left, *node.comparators]
+            elif isinstance(node, ast.BinOp):
+                operands = [node.left, node.right]
+            if operands:
+                spaces = [self._space_of(o, env) for o in operands]
+                has_bin = "bin" in spaces
+                has_stat = "stat" in spaces or any(
+                    self._is_float_literal(o) for o in operands)
+                if has_bin and has_stat:
+                    what = ("comparison" if isinstance(node, ast.Compare)
+                            else "arithmetic")
+                    self.emit(
+                        "GL013", node,
+                        f"{what} mixes u8 bin codes with dequantized "
+                        f"f32 values — bin codes are ordinal, not "
+                        f"magnitudes (PARITY.md: the quantized space IS "
+                        f"the compute space); route in bin space or "
+                        f"dequantize BOTH sides first")
+                    continue
+            if not isinstance(node, ast.Call):
+                continue
+            if self._collective_call(node) == "ppermute" and node.args \
+                    and not self._in_sanctioned_hop(info):
+                if self._space_of(node.args[0], env) in WIRE_SPACES:
+                    self.emit(
+                        "GL013", node,
+                        "lax.ppermute of a quantized (int8/bf16) payload "
+                        "outside wire_transfer — each ring hop must "
+                        "requantize against the CURRENT partial's scale "
+                        "(ops/quantize.wire_transfer), or D-1 hops "
+                        "compound the quantization error unbounded")
+            elif self._int8_accumulation(node, info, env):
+                if not self._module_has_int8_guard():
+                    self.emit(
+                        "GL013", node,
+                        f"int8 accumulation into int32 without a "
+                        f"row-count guard in this module — past "
+                        f"{INT8_ACC_ROW_LIMIT:,} rows a (segment, bin) "
+                        f"cell can exceed 2^31-1 and wrap silently; "
+                        f"compare rows against INT8_ACC_ROW_LIMIT "
+                        f"(= (1 << 31) // 127) and raise before "
+                        f"dispatch")
+
+
+# ---------------------------------------------------------------------------
+# GL012 probe — the tools/hlo_counts.py shim re-exports this
+# ---------------------------------------------------------------------------
+def mesh_probe(path: str, src: Optional[str] = None) -> List[dict]:
+    """Per-function mesh-context report for one module (per-file mode:
+    cross-module seeds and imported axis constants are not visible —
+    `axes_complete` is False for contexts that need them).
+
+    Returns one dict per named function that is meshed or performs a
+    collective: ``{"function", "line", "meshed", "axes",
+    "axes_complete", "collectives": [{"op", "line", "axis"}...]}``.
+    """
+    if src is None:
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+    tree = ast.parse(src)
+    analysis = _ModuleAnalysis(path, tree, is_kernel_file(src))
+    analysis.close_local()
+    out: List[dict] = []
+    for info in analysis.funcs:
+        if not info.name:
+            continue
+        collectives = []
+        for node in info.strict_own_nodes():
+            if isinstance(node, ast.Call):
+                coll = analysis._collective_call(node)
+                if coll is not None:
+                    _, axis = analysis._collective_axis(node, info)
+                    collectives.append({"op": coll, "line": node.lineno,
+                                        "axis": axis})
+        if info.meshed or collectives:
+            out.append({
+                "function": info.name,
+                "line": info.node.lineno,
+                "meshed": info.meshed,
+                "axes": sorted(info.mesh_axes),
+                "axes_complete": info.meshed and not info.mesh_unknown,
+                "collectives": collectives,
+            })
+    return out
+
 
 RULE_IDS = ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-            "GL008", "GL009", "GL010", "GL011")
+            "GL008", "GL009", "GL010", "GL011", "GL012", "GL013", "GL014")
 
 
 _KERNEL_FILE_RE = re.compile(

@@ -1,0 +1,25 @@
+"""Deliberately-broken graftlint fixture for the check.sh v3 lane.
+
+tools/check.sh lints THIS file with ``--format github`` and asserts the
+run exits 1 with ``::error`` annotations carrying the expected rule ids
+— proving the v3 families run and the CI annotation format holds.
+
+The default lint pass never sees this file: ``fixtures`` is in the
+engine's ``_SKIP_DIRS`` and pytest doesn't collect it (no ``test_``
+prefix).  Only explicit-path invocations lint it.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def merge_without_mesh(hist):
+    # GL012: literal axis, no shard_map/pmap reaches this function
+    return lax.psum(hist, "data")
+
+
+def route_in_mixed_space(rows, thresholds, scale):
+    # GL013: u8 bin codes compared against dequantized f32 thresholds
+    codes = rows.astype(jnp.uint8)
+    deq = thresholds.astype(jnp.float32) * scale
+    return codes <= deq

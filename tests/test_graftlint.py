@@ -709,17 +709,27 @@ def test_cli_exit_3_internal_error(tmp_path, capsys):
 
 
 @pytest.mark.lint
-def test_gl000_parse_failure_bypasses_baseline_and_waivers(tmp_path,
-                                                           capsys):
+def test_gl000_parse_failure_bypasses_waivers(tmp_path, capsys):
     bad = tmp_path / "broken.py"
     # the waiver comment is unreachable: the file does not parse
     bad.write_text("def f(:  # graftlint: GL000 — nope\n")
+    assert lint_main([str(bad), "--no-baseline", "--no-vmem", "-q"]) == 1
+    assert "GL000" in capsys.readouterr().out
+
+
+@pytest.mark.lint
+def test_gl000_baseline_attempt_is_a_usage_error(tmp_path, capsys):
+    # r20: trying to BASELINE a parse failure is rejected when the
+    # ledger is read, before any file is analyzed — exit 2, not a
+    # silently-ignored entry
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
     b = tmp_path / "b.toml"
     b.write_text(f'[[suppress]]\nrule = "GL000"\npath = "{bad}"\n'
                  f'count = 5\nreason = "trying to baseline a parse "\n')
     assert lint_main([str(bad), "--baseline", str(b),
-                      "--no-vmem", "-q"]) == 1
-    assert "GL000" in capsys.readouterr().out
+                      "--no-vmem", "-q"]) == 2
+    assert "never baselineable" in capsys.readouterr().err
 
 
 def test_vmem_specs_fit_budget():
@@ -746,3 +756,466 @@ def test_fused_train_step_single_compile():
     r = fused_train_step_recompiles(n_hyper_batches=3)
     assert r["ok"], r
     assert r["compiles"] <= 1
+
+
+# ---------------------------------------------------------------------------
+# r20 tentpole: GL012 mesh/collective discipline
+# ---------------------------------------------------------------------------
+
+GL012_BAD = """\
+import jax
+from jax import lax
+
+def merge(hist):
+    return lax.psum(hist, "data")
+"""
+
+GL012_GOOD = """\
+import jax
+from jax import lax
+
+def merge(hist, axis_name):
+    return lax.psum(hist, axis_name)
+"""
+
+GL012_MISMATCH = """\
+import jax
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+def step(v):
+    return lax.psum(v, "rows")
+
+def run(mesh, x):
+    f = shard_map(step, mesh=mesh, in_specs=P("data"),
+                  out_specs=P("data"))
+    return f(x)
+"""
+
+GL012_NESTED_GOOD = """\
+import jax
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+def run(mesh, x):
+    def body(v):
+        return lax.psum(v, "data")
+    f = shard_map(body, mesh=mesh, in_specs=P("data"),
+                  out_specs=P("data"))
+    return f(x)
+"""
+
+GL012_COND_BAD = """\
+import jax
+from jax import lax
+
+@jax.jit
+def maybe_merge(pred, v, axis_name):
+    return lax.cond(pred,
+                    lambda t: lax.psum(t, axis_name),
+                    lambda t: t, v)
+"""
+
+GL012_COND_GOOD = """\
+import jax
+from jax import lax
+
+@jax.jit
+def maybe_merge(pred, v, axis_name):
+    return lax.cond(pred,
+                    lambda t: lax.psum(t, axis_name),
+                    lambda t: lax.psum(t * 0, axis_name), v)
+"""
+
+
+def test_gl012_collective_outside_mesh():
+    # literal axis, no shard_map/pmap reaches merge() -> SPMD hang shape
+    assert rules_at(GL012_BAD, "GL012") == [line_of(GL012_BAD, "psum")]
+
+
+def test_gl012_parameter_axis_is_sanctioned():
+    # the caller owns the binding: a helper taking axis_name= never fires
+    assert rules_at(GL012_GOOD, "GL012") == []
+
+
+def test_gl012_axis_name_disagrees_with_mesh_specs():
+    lines = rules_at(GL012_MISMATCH, "GL012")
+    assert lines == [line_of(GL012_MISMATCH, '"rows"')]
+    fs = [f for f in findings(GL012_MISMATCH) if f.rule == "GL012"]
+    assert "'rows'" in fs[0].message and "'data'" in fs[0].message
+    good = GL012_MISMATCH.replace('"rows"', '"data"')
+    assert rules_at(good, "GL012") == []
+
+
+def test_gl012_nested_closure_idiom_is_meshed():
+    # the standard spelling: the collective-bearing body is a def NESTED
+    # in the function that calls shard_map on it — in-mesh, silent
+    assert rules_at(GL012_NESTED_GOOD, "GL012") == []
+    # ...and the same nesting with the wrong axis still fires mismatch
+    wrong = GL012_NESTED_GOOD.replace('lax.psum(v, "data")',
+                                      'lax.psum(v, "rows")')
+    assert rules_at(wrong, "GL012") == [line_of(wrong, '"rows"')]
+
+
+def test_gl012_inline_lambda_entry_is_meshed():
+    src = ("import jax\nfrom jax import lax\n"
+           "from jax.experimental.shard_map import shard_map\n"
+           "from jax.sharding import PartitionSpec as P\n\n"
+           "def run(mesh, x):\n"
+           '    return shard_map(lambda v: lax.psum(v, "data"),\n'
+           '                     mesh=mesh, in_specs=P("data"),\n'
+           '                     out_specs=P("data"))(x)\n')
+    assert rules_at(src, "GL012") == []
+    wrong = src.replace('"data"),\n                     mesh',
+                        '"rows"),\n                     mesh')
+    assert rules_at(wrong, "GL012") == [line_of(wrong, '"rows"')]
+
+
+def test_gl012_unbalanced_cond_collective():
+    # one branch psums, the other doesn't: half the mesh enters the
+    # collective, the other half never will — the deadlock shape
+    assert rules_at(GL012_COND_BAD, "GL012") == [
+        line_of(GL012_COND_BAD, "lax.cond")]
+    fs = [f for f in findings(GL012_COND_BAD) if f.rule == "GL012"]
+    assert "branch" in fs[0].message
+
+
+def test_gl012_lockstep_cond_twin_is_silent():
+    # both branches perform a collective -> lock-step, no finding
+    assert rules_at(GL012_COND_GOOD, "GL012") == []
+
+
+def test_gl012_axis_resolves_through_module_constant():
+    src = ("import jax\nfrom jax import lax\n"
+           "from jax.experimental.shard_map import shard_map\n"
+           "from jax.sharding import PartitionSpec as P\n\n"
+           'DATA_AXIS = "data"\n\n'
+           "def step(v):\n"
+           "    return lax.psum(v, DATA_AXIS)\n\n"
+           "def run(mesh, x):\n"
+           "    return shard_map(step, mesh=mesh, in_specs=P(DATA_AXIS),\n"
+           "                     out_specs=P(DATA_AXIS))(x)\n")
+    assert rules_at(src, "GL012") == []
+    # the constant resolving to a NON-mesh axis fires the mismatch
+    wrong = src.replace('DATA_AXIS = "data"\n\n',
+                        'DATA_AXIS = "data"\nROW_AXIS = "rows"\n\n').replace(
+        "lax.psum(v, DATA_AXIS)", "lax.psum(v, ROW_AXIS)")
+    assert rules_at(wrong, "GL012") == [line_of(wrong, "ROW_AXIS)")]
+
+
+def test_gl012_unresolvable_mesh_axes_disable_agreement_only():
+    # specs built from a runtime value: membership holds (no
+    # outside-mesh finding) but the axis-agreement check stands down
+    src = ("import jax\nfrom jax import lax\n"
+           "from jax.experimental.shard_map import shard_map\n"
+           "from jax.sharding import PartitionSpec as P\n\n"
+           "def step(v):\n"
+           '    return lax.psum(v, "whatever")\n\n'
+           "def run(smesh, x):\n"
+           "    return shard_map(step, mesh=smesh.mesh,\n"
+           "                     in_specs=P(smesh.axis_name),\n"
+           "                     out_specs=P(smesh.axis_name))(x)\n")
+    assert rules_at(src, "GL012") == []
+
+
+def test_cross_module_mesh_closure():
+    # the collective helper lives in another FILE; only the Program
+    # closure can see the shard_map entry that meshes it — and the
+    # axis constant resolves through the import table
+    axes = 'DATA_AXIS = "data"\n'
+    helper = ("from jax import lax\nfrom pkg.axes import DATA_AXIS\n\n"
+              "def merge(hist):\n"
+              "    return lax.psum(hist, DATA_AXIS)\n")
+    entry = ("import jax\n"
+             "from jax.experimental.shard_map import shard_map\n"
+             "from jax.sharding import PartitionSpec as P\n"
+             "from pkg.axes import DATA_AXIS\n"
+             "from pkg.helper import merge\n\n"
+             "def run(mesh, x):\n"
+             "    return shard_map(merge, mesh=mesh,\n"
+             "                     in_specs=P(DATA_AXIS),\n"
+             "                     out_specs=P(DATA_AXIS))(x)\n")
+    mods = [("pkg/axes.py", axes), ("pkg/helper.py", helper),
+            ("pkg/entry.py", entry)]
+    assert prog_findings(mods, "GL012") == []
+    # per-file analysis of the helper ALONE flags the psum as
+    # outside-mesh; the entry module is what sanctions it
+    assert prog_findings(mods[:2], "GL012") != []
+    # and a wrong axis still fires THROUGH the closure, in the helper
+    bad = [("pkg/axes.py", axes + 'ROW_AXIS = "rows"\n'),
+           ("pkg/helper.py", helper.replace("DATA_AXIS", "ROW_AXIS")),
+           ("pkg/entry.py", entry)]
+    fs = prog_findings(bad, "GL012")
+    assert [(f.path, f.line) for f in fs] == [("pkg/helper.py", 5)]
+
+
+# ---------------------------------------------------------------------------
+# r20 tentpole: GL013 quantized-space discipline
+# ---------------------------------------------------------------------------
+
+GL013_BAD = """\
+import jax.numpy as jnp
+
+def route(rows, thresholds, scale):
+    codes = rows.astype(jnp.uint8)
+    deq = thresholds.astype(jnp.float32) * scale
+    return codes <= deq
+"""
+
+GL013_GOOD = """\
+import jax.numpy as jnp
+
+def route(rows, thresholds):
+    codes = rows.astype(jnp.uint8)
+    cuts = thresholds.astype(jnp.uint8)
+    return codes <= cuts
+"""
+
+GL013_ACC_BAD = """\
+import jax.numpy as jnp
+from jax import lax
+
+def accumulate(onehot, grads):
+    oh = onehot.astype(jnp.int8)
+    q = grads.astype(jnp.int8)
+    return lax.dot_general(oh, q, (((0,), (0,)), ((), ())),
+                           preferred_element_type=jnp.int32)
+"""
+
+GL013_ACC_GOOD = """\
+import jax.numpy as jnp
+from jax import lax
+
+INT8_ACC_ROW_LIMIT = (1 << 31) // 127
+
+def accumulate(onehot, grads, n):
+    if n > INT8_ACC_ROW_LIMIT:
+        raise ValueError("int8 accumulation overflows past the limit")
+    oh = onehot.astype(jnp.int8)
+    q = grads.astype(jnp.int8)
+    return lax.dot_general(oh, q, (((0,), (0,)), ((), ())),
+                           preferred_element_type=jnp.int32)
+"""
+
+GL013_HOP_BAD = """\
+import jax.numpy as jnp
+from jax import lax
+
+def ring_send(payload, perm, axis_name):
+    q = payload.astype(jnp.int8)
+    return lax.ppermute(q, axis_name, perm)
+"""
+
+
+def test_gl013_bin_code_vs_dequantized_mix():
+    # u8 bin codes compared against f32 values: off-by-one routing
+    # vs the quantized-space contract (PARITY.md r18)
+    assert rules_at(GL013_BAD, "GL013") == [
+        line_of(GL013_BAD, "codes <= deq")]
+    fs = [f for f in findings(GL013_BAD) if f.rule == "GL013"]
+    assert "bin" in fs[0].message
+
+
+def test_gl013_same_space_comparison_is_silent():
+    assert rules_at(GL013_GOOD, "GL013") == []
+
+
+def test_gl013_bin_vs_float_literal_fires_int_is_fine():
+    lit = ("import jax.numpy as jnp\n\ndef f(rows):\n"
+           "    codes = rows.astype(jnp.uint8)\n"
+           "    return codes <= 0.5\n")
+    assert rules_at(lit, "GL013") == [line_of(lit, "0.5")]
+    # an INT literal is a valid bin code — stays silent
+    assert rules_at(lit.replace("0.5", "255"), "GL013") == []
+
+
+def test_gl013_stat_space_is_absorbing_through_binop():
+    # f32 * unknown promotes to f32 (JAX promotion): the mix must
+    # still be proven through the arithmetic
+    src = GL013_BAD.replace("thresholds.astype(jnp.float32) * scale",
+                            "scale * thresholds.astype(jnp.float32)")
+    assert rules_at(src, "GL013") == [line_of(src, "codes <= deq")]
+
+
+def test_gl013_unguarded_int8_accumulation():
+    assert rules_at(GL013_ACC_BAD, "GL013") == [
+        line_of(GL013_ACC_BAD, "dot_general")]
+    fs = [f for f in findings(GL013_ACC_BAD) if f.rule == "GL013"]
+    assert "16,909,320" in fs[0].message or "16909320" in fs[0].message
+
+
+def test_gl013_guarded_int8_accumulation_twin_is_silent():
+    # the module carries the (1 << 31) // 127 row-count guard the rule
+    # demands -> silent
+    assert rules_at(GL013_ACC_GOOD, "GL013") == []
+
+
+def test_gl013_wire_payload_hop_outside_requantize_boundary():
+    assert rules_at(GL013_HOP_BAD, "GL013") == [
+        line_of(GL013_HOP_BAD, "ppermute")]
+    # inside the sanctioned boundary (wire_transfer) the hop is THE
+    # requantize point — silent
+    good = GL013_HOP_BAD.replace("def ring_send", "def wire_transfer")
+    assert rules_at(good, "GL013") == []
+    # an f32 payload needs no requantize — silent
+    f32 = GL013_HOP_BAD.replace("jnp.int8", "jnp.float32")
+    assert rules_at(f32, "GL013") == []
+
+
+# ---------------------------------------------------------------------------
+# r20 tentpole: GL014 parity-contract anchors
+# ---------------------------------------------------------------------------
+
+def test_gl014_real_tree_anchors_all_live():
+    from lightgbm_tpu.analysis.engine import REPO_ROOT
+    from lightgbm_tpu.analysis.program import parity_anchor_findings
+
+    assert parity_anchor_findings(REPO_ROOT) == []
+
+
+def test_gl014_dead_symbol_fails_the_contract():
+    from lightgbm_tpu.analysis.engine import REPO_ROOT
+    from lightgbm_tpu.analysis.program import parity_anchor_findings
+
+    anchors = {"Quantized-threshold comparison rule (r18 serving)": (
+        ("lightgbm_tpu/ops/predict.py", "predict_forest_pallas_v2"),)}
+    fs = parity_anchor_findings(REPO_ROOT, anchors=anchors)
+    dead = [f for f in fs if "no longer exists" in f.message]
+    assert len(dead) == 1 and dead[0].rule == "GL014"
+    assert "predict_forest_pallas_v2" in dead[0].message
+    assert dead[0].path == "PARITY.md" and dead[0].line > 1
+
+
+def test_gl014_missing_module_fails_the_contract():
+    from lightgbm_tpu.analysis.engine import REPO_ROOT
+    from lightgbm_tpu.analysis.program import parity_anchor_findings
+
+    anchors = {"Quantized-threshold comparison rule (r18 serving)": (
+        ("lightgbm_tpu/ops/gone.py", "predict_forest_pallas"),)}
+    fs = parity_anchor_findings(REPO_ROOT, anchors=anchors)
+    gone = [f for f in fs if "missing or unparseable" in f.message]
+    assert len(gone) == 1 and "ops/gone.py" in gone[0].message
+
+
+def test_gl014_stale_anchor_key_fires():
+    from lightgbm_tpu.analysis.engine import REPO_ROOT
+    from lightgbm_tpu.analysis.program import parity_anchor_findings
+
+    anchors = {"A contract heading that was renamed away": ()}
+    fs = parity_anchor_findings(REPO_ROOT, anchors=anchors)
+    stale = [f for f in fs if "no such heading" in f.message]
+    assert len(stale) == 1 and stale[0].line == 1
+
+
+def test_gl014_unanchored_claim_fires_at_its_heading():
+    from lightgbm_tpu.analysis.program import parity_anchor_findings
+
+    doc = ("# parity\n\n## Some new kernel rule\n\n"
+           "The fused path is bit-identical to the scan path.\n")
+    fs = parity_anchor_findings("/nonexistent", anchors={}, parity_md=doc)
+    assert [(f.line, f.rule) for f in fs] == [(3, "GL014")]
+    assert "no PARITY_ANCHORS entry" in fs[0].message
+
+
+def test_gl014_table_rows_are_not_claims():
+    from lightgbm_tpu.analysis.program import parity_anchor_findings
+
+    doc = ("# parity\n\n## Feature inventory\n\n"
+           "| knob | behavior |\n|---|---|\n"
+           "| unknown-param tolerance | warn |\n")
+    assert parity_anchor_findings("/x", anchors={}, parity_md=doc) == []
+
+
+def test_gl014_missing_parity_doc_with_live_anchors():
+    from lightgbm_tpu.analysis.program import (PARITY_ANCHORS,
+                                               parity_anchor_findings)
+
+    fs = parity_anchor_findings("/nonexistent", anchors=PARITY_ANCHORS)
+    assert len(fs) == 1 and "missing" in fs[0].message
+    assert fs[0].line == 1
+
+
+# ---------------------------------------------------------------------------
+# r20 satellites: --explain, baseline rule-id validation, CLI coverage
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lint
+def test_cli_explain_prints_rules_md_section(capsys):
+    assert lint_main(["--explain", "GL013"]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("## GL013")
+    assert "quantized-space" in out
+    # the section is cut at the NEXT heading — no bleed-through
+    assert "GL014" not in out.replace("GL013", "")
+
+
+@pytest.mark.lint
+def test_cli_explain_unknown_rule_is_usage_error(capsys):
+    assert lint_main(["--explain", "GL099"]) == 2
+    err = capsys.readouterr().err
+    assert err.startswith("graftlint: usage-error:")
+    assert "GL099" in err and "GL012" in err   # lists the known ids
+
+
+@pytest.mark.lint
+def test_cli_explain_requires_an_argument(capsys):
+    assert lint_main(["--explain"]) == 2
+    assert "usage-error" in capsys.readouterr().err
+
+
+@pytest.mark.parametrize("rule,msg", [
+    ("GL9999", "malformed"), ("bogus", "malformed"),
+    ("GL999", "unknown rule id"), ("GL000", "never baselineable"),
+])
+def test_baseline_rejects_bad_rule_ids(rule, msg):
+    with pytest.raises(BaselineError, match=msg):
+        parse_baseline(f'[[suppress]]\nrule = "{rule}"\n'
+                       f'path = "p.py"\ncount = 1\nreason = "r"\n')
+
+
+@pytest.mark.lint
+@pytest.mark.parametrize("snippet,rule", [
+    (GL012_BAD, "GL012"), (GL013_BAD, "GL013"),
+], ids=["GL012", "GL013"])
+def test_cli_nonzero_per_r20_seeded_rule(tmp_path, snippet, rule, capsys):
+    p = tmp_path / f"{rule.lower()}.py"
+    p.write_text(snippet)
+    assert lint_main([str(p), "--no-vmem", "--no-baseline", "-q"]) == 1
+    assert rule in capsys.readouterr().out
+
+
+@pytest.mark.lint
+def test_seeded_fixture_matches_check_sh_expectations(capsys):
+    # tools/check.sh greps for these exact annotations; keep the fixture
+    # and the lane in lock-step
+    from lightgbm_tpu.analysis.engine import REPO_ROOT
+
+    fx = os.path.join(REPO_ROOT, "tests", "fixtures",
+                      "graftlint_seeded.py")
+    assert lint_main([fx, "--no-vmem", "--no-baseline",
+                      "--format", "github", "-q"]) == 1
+    out = capsys.readouterr().out
+    assert "title=graftlint GL012::" in out
+    assert "title=graftlint GL013::" in out
+
+
+def test_mesh_probe_shim_reexports():
+    # tools/hlo_counts.py re-exports the GL012 probe surface; the probe
+    # itself reports meshed functions with their collectives
+    import importlib.util
+    from lightgbm_tpu.analysis.engine import REPO_ROOT
+
+    spec = importlib.util.spec_from_file_location(
+        "hlo_counts", os.path.join(REPO_ROOT, "tools", "hlo_counts.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert "psum" in mod.COLLECTIVE_CALLS
+    assert "shard_map" in mod.MESH_ENTRY_CALLS
+    probe = mod.mesh_probe(
+        "fix.py", src=GL012_MISMATCH)
+    by_name = {p["function"]: p for p in probe}
+    assert by_name["step"]["meshed"]
+    assert by_name["step"]["axes"] == ["data"]
+    assert [c["op"] for c in by_name["step"]["collectives"]] == ["psum"]

@@ -1,12 +1,15 @@
 #!/usr/bin/env bash
-# One command for the whole gate: style -> lint-v2 -> parity/chaos lanes.
+# One command for the whole gate: style -> lint-v3 -> parity/chaos lanes.
 #
 #   tools/check.sh          # everything, including launch budgets +
 #                           # recompile sweeps (~minutes on CPU)
 #
-# The r16 lint-v2 lane runs the whole-program graftlint pass AND the
-# trace-level budgets unconditionally; `--full` is kept as a no-op so
-# existing invocations don't break.
+# The r20 lint-v3 lane runs the whole-program graftlint pass (now
+# including the GL012 mesh-collective closure, the GL013 quantized-space
+# lattice, and the GL014 parity-contract anchors), verifies the
+# `--format github` CI annotations against a seeded fixture, AND runs
+# the trace-level budgets unconditionally; `--full` is kept as a no-op
+# so existing invocations don't break.
 #
 # Exit: nonzero on the first failing layer.  Tier-1 already runs the
 # same checks through the pytest bridge (`-m lint`); this script is the
@@ -30,19 +33,41 @@ else
   echo "== ruff == (not installed; skipping style layer)"
 fi
 
-# 2. lint-v2: the whole-program graftlint pass — cross-module traced
+# 2. lint-v3: the whole-program graftlint pass — cross-module traced
 #    closure, determinism (GL008), lock discipline (GL009), fault-site
-#    registry drift (GL010), typed-error discipline (GL011), budget
-#    anchors — plus the VMEM estimates and the arithmetic budget models
-#    (comm bytes/time, stream, serve SLO, ckpt, freshness).  GL000
-#    parse failures bypass the baseline AND waivers, so an unparseable
-#    file fails this lane hard; exit 3 means the analyzer itself broke.
-echo "== lint-v2 (whole-program graftlint) =="
+#    registry drift (GL010), typed-error discipline (GL011), mesh/
+#    collective discipline (GL012), quantized-space discipline (GL013),
+#    parity-contract anchors (GL014), budget anchors — plus the VMEM
+#    estimates and the arithmetic budget models (comm bytes/time,
+#    stream, serve SLO, ckpt, freshness).  GL000 parse failures bypass
+#    the baseline AND waivers, so an unparseable file fails this lane
+#    hard; exit 3 means the analyzer itself broke.
+echo "== lint-v3 (whole-program graftlint) =="
 JAX_PLATFORMS=cpu python -m lightgbm_tpu lint
+
+#    ...verify the CI annotation surface on the seeded fixture: the v3
+#    families must fire (exit 1, not 0/2/3) and every finding must come
+#    out as a ::error workflow-annotation line with its rule id
+echo "== lint-v3: --format github annotations (seeded fixture) =="
+set +e
+gh_out=$(JAX_PLATFORMS=cpu python -m lightgbm_tpu lint \
+  tests/fixtures/graftlint_seeded.py --no-vmem --no-baseline \
+  --format github -q)
+gh_rc=$?
+set -e
+if [ "$gh_rc" -ne 1 ]; then
+  echo "seeded fixture: expected exit 1 (findings), got $gh_rc" >&2
+  exit 1
+fi
+echo "$gh_out" | grep -q "^::error file=tests/fixtures/graftlint_seeded.py,line=[0-9]*,col=[0-9]*,title=graftlint GL012::" || {
+  echo "seeded fixture: missing GL012 ::error annotation" >&2; exit 1; }
+echo "$gh_out" | grep -q "title=graftlint GL013::" || {
+  echo "seeded fixture: missing GL013 ::error annotation" >&2; exit 1; }
+echo "github annotations ok"
 
 #    ...plus the trace-level budgets: HLO launch counts + zero-recompile
 #    sweeps (lowers real entry points; ~a minute on CPU)
-echo "== lint-v2: launch budgets + recompile sweeps =="
+echo "== lint-v3: launch budgets + recompile sweeps =="
 JAX_PLATFORMS=cpu python -m lightgbm_tpu lint --budgets -q
 echo "budget specs ok"
 

@@ -1,12 +1,21 @@
-"""Compiled-HLO op-count probes — thin shim over the graftlint budgets.
+"""Compiled-HLO op-count probes — thin shim over the graftlint models.
 
 The launch-count model (r7) moved into ``lightgbm_tpu.analysis.budgets``
 so the lint gate, the tier-1 tests, and the bench artifacts consume ONE
 model; this module keeps the historical import path
 (``tools.hlo_counts``) and the ``python tools/hlo_counts.py [E]`` CLI.
 
-See lightgbm_tpu/analysis/budgets.py for what each view means
-(cpu_body vs ``stub=True`` TPU launch model).
+r20 extends the shim the same way for the GL012 mesh-context probe:
+``mesh_probe`` and the collective/mesh-entry vocabularies re-export
+from ``lightgbm_tpu.analysis.rules`` — the linter's closure IS the
+model, nothing is duplicated here.  ``python tools/hlo_counts.py
+--mesh PATH`` prints the per-function mesh report for one module
+(which functions a shard_map reaches, with which axes, and every
+collective they perform).
+
+See lightgbm_tpu/analysis/budgets.py for what each launch view means
+(cpu_body vs ``stub=True`` TPU launch model) and analysis/RULES.md
+(GL012) for the mesh-context semantics.
 """
 
 from __future__ import annotations
@@ -28,9 +37,22 @@ from lightgbm_tpu.analysis.budgets import (  # noqa: E402,F401
     split_iter_counts,
     while_body_counts,
 )
+from lightgbm_tpu.analysis.rules import (  # noqa: E402,F401
+    COLLECTIVE_CALLS,
+    MESH_ENTRY_CALLS,
+    mesh_probe,
+)
 
 if __name__ == "__main__":
     import json
 
-    e = int(sys.argv[1]) if len(sys.argv) > 1 else 40
-    print(json.dumps(kernels_per_round_summary(e=e), indent=1))
+    args = sys.argv[1:]
+    if args and args[0] == "--mesh":
+        if len(args) < 2:
+            print("usage: python tools/hlo_counts.py --mesh PATH",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        print(json.dumps(mesh_probe(args[1]), indent=1))
+    else:
+        e = int(args[0]) if args else 40
+        print(json.dumps(kernels_per_round_summary(e=e), indent=1))
