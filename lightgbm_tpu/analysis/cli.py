@@ -152,8 +152,9 @@ def _run(argv: Optional[List[str]] = None) -> int:
     # pure arithmetic — always on, like the VMEM estimates
     from .budgets import (check_ckpt_budgets, check_comm_budgets,
                           check_comm_time_budgets, check_freshness_budgets,
-                          check_serve_slo_budgets, check_stream_budgets,
-                          check_stream_dp_budgets, check_sweep_budgets)
+                          check_screen_budgets, check_serve_slo_budgets,
+                          check_stream_budgets, check_stream_dp_budgets,
+                          check_sweep_budgets)
 
     res = check_comm_budgets()
     sections["comm_budgets"] = res
@@ -185,6 +186,10 @@ def _run(argv: Optional[List[str]] = None) -> int:
 
     res = check_sweep_budgets()
     sections["sweep"] = res
+    failed |= any(not r["ok"] for r in res)
+
+    res = check_screen_budgets()
+    sections["screen"] = res
     failed |= any(not r["ok"] for r in res)
 
     # Layer-2 stale-entry reporting: budget specs must anchor to live
@@ -238,8 +243,8 @@ def _run(argv: Optional[List[str]] = None) -> int:
             print(f"stale baseline entry: {line}")
         for key in ("vmem", "comm_budgets", "comm_time", "stream_time",
                     "stream_dp", "serve_slo", "ckpt", "freshness",
-                    "sweep", "budget_anchors", "launch_budgets",
-                    "recompile"):
+                    "sweep", "screen", "budget_anchors",
+                    "launch_budgets", "recompile"):
             for r in sections.get(key, ()):
                 mark = "ok" if r["ok"] else "FAIL"
                 detail = (f"{r['estimated_mb']}/{r['budget_mb']} MB"

@@ -438,6 +438,18 @@ PARITY_ANCHORS: Dict[str, Tuple[Tuple[str, str], ...]] = {
         ("tests/test_stream_dp.py",
          "test_elastic_resume_first_round_bit_identical_across_d"),
     ),
+    "Feature-screening exactness rule (r20)": (
+        ("lightgbm_tpu/models/feature_mask.py", "FeatureScreener"),
+        ("lightgbm_tpu/models/feature_mask.py", "compose_tree_mask"),
+        ("lightgbm_tpu/models/feature_mask.py", "remap_split_features"),
+        ("lightgbm_tpu/data/block_store.py", "ColumnViewStore"),
+        ("tests/test_screening.py",
+         "test_screen_off_bit_identical_strict_and_wave"),
+        ("tests/test_screening.py",
+         "test_screened_in_memory_matches_streamed"),
+        ("tests/test_screening.py",
+         "test_refresh_rediscovers_late_gain_feature"),
+    ),
 }
 
 

@@ -100,6 +100,16 @@ _ALIASES: Dict[str, str] = {
     "sub_feature_bynode": "feature_fraction_bynode",
     "colsample_bynode": "feature_fraction_bynode",
     "feature_fraction_seed": "feature_fraction_seed",
+    # r20 gain-informed feature screening (EMA-FS)
+    "feature_screen": "feature_screen",
+    "feature_screening": "feature_screen",
+    "screen_features": "feature_screen",
+    "screen_ema_decay": "screen_ema_decay",
+    "screen_decay": "screen_ema_decay",
+    "screen_keep_ratio": "screen_keep_ratio",
+    "screen_keep": "screen_keep_ratio",
+    "screen_refresh_rounds": "screen_refresh_rounds",
+    "screen_refresh": "screen_refresh_rounds",
     "extra_trees": "extra_trees",
     "monotone_constraints": "monotone_constraints",
     "mc": "monotone_constraints",
@@ -375,6 +385,15 @@ class Params:
     feature_fraction: float = 1.0
     feature_fraction_bynode: float = 1.0
     feature_fraction_seed: int = 2
+    # gain-informed feature screening (r20, EMA-FS arXiv:2606.26337):
+    # "ema" keeps per-feature gain EWMAs and grows screened rounds over
+    # the hottest ceil(keep_ratio * F) columns, with a full-refresh
+    # round every screen_refresh_rounds for exactness + cold-feature
+    # rediscovery; "off" (default) is bit-identical to pre-r20 trees
+    feature_screen: str = "off"
+    screen_ema_decay: float = 0.9
+    screen_keep_ratio: float = 0.25
+    screen_refresh_rounds: int = 10
     extra_trees: bool = False
     # monotone constraints (basic method) + leaf-path smoothing
     monotone_constraints: Optional[List[int]] = None
@@ -616,6 +635,20 @@ def _validate(p: Params) -> None:
             f"{p.tree_learner!r}")
     if p.top_k < 1:
         raise ValueError(f"top_k must be >= 1, got {p.top_k}")
+    if p.feature_screen not in ("off", "ema"):
+        raise ValueError(
+            f"feature_screen must be off/ema, got {p.feature_screen!r}")
+    if not (0.0 < p.screen_ema_decay < 1.0):
+        raise ValueError(
+            f"screen_ema_decay must be in (0, 1), got {p.screen_ema_decay}")
+    if not (0.0 < p.screen_keep_ratio <= 1.0):
+        raise ValueError(
+            f"screen_keep_ratio must be in (0, 1], got "
+            f"{p.screen_keep_ratio}")
+    if p.screen_refresh_rounds < 1:
+        raise ValueError(
+            f"screen_refresh_rounds must be >= 1, got "
+            f"{p.screen_refresh_rounds}")
     if p.monotone_constraints is not None:
         if any(c not in (-1, 0, 1) for c in p.monotone_constraints):
             raise ValueError(

@@ -151,11 +151,16 @@ class BlockStore:
                 "after construction)", block=k, kind="corrupt")
         return b
 
-    def _fetch_device(self, k: int):
+    def _fetch_device(self, k: int, col_ids=None):
         """Read + transfer block ``k`` with the bounded retry: transient
         errors (injected ``block_read``/``device_put`` faults, runtime
         transfer hiccups) back off exponentially and retry up to
-        ``max_read_retries`` times; integrity failures never retry."""
+        ``max_read_retries`` times; integrity failures never retry.
+
+        ``col_ids`` (r20 feature screening) slices the block to the
+        active columns on the HOST, after the integrity verify (the
+        checksum covers the full block as written) and before the
+        device_put — so only ``F_active`` columns ever cross PCIe."""
         import jax
 
         from ..faults import FaultError
@@ -169,6 +174,8 @@ class BlockStore:
                 if self.fault_injector is not None:
                     self.fault_injector.check("block_read")
                 b = self._verify_block(k)
+                if col_ids is not None:
+                    b = np.ascontiguousarray(b[:, col_ids])
                 if self.fault_injector is not None:
                     self.fault_injector.check("device_put")
                 return (jax.device_put(b) if self.device is None
@@ -183,7 +190,7 @@ class BlockStore:
             kind="read",
             attempts=self.max_read_retries + 1) from last
 
-    def device_blocks(self, prefetch_blocks: int = None
+    def device_blocks(self, prefetch_blocks: int = None, col_ids=None
                       ) -> Iterator[Tuple[int, "object"]]:
         """Yield ``(row_offset, device_block)`` with ``prefetch_blocks``
         lookahead: blocks k+1..k+P have their ``jax.device_put`` issued
@@ -191,7 +198,9 @@ class BlockStore:
         copies run while the consumer's histogram kernel chews on block k
         (async dispatch).  Depth defaults to the store's configured
         ``prefetch_blocks`` (the ``stream_prefetch_blocks`` param); depth
-        1 is the classic double buffer."""
+        1 is the classic double buffer.  ``col_ids`` streams only the
+        active columns (r20 screening) — the odometer counts the SLICED
+        bytes, since that is what actually crossed PCIe."""
         depth = self.prefetch_blocks if prefetch_blocks is None \
             else int(prefetch_blocks)
         if depth < 1:
@@ -203,25 +212,32 @@ class BlockStore:
         window: deque = deque()
         n = len(self.blocks)
         for k in range(min(depth, n)):
-            window.append(self._fetch_device(k))
+            window.append(self._fetch_device(k, col_ids))
         for k in range(n):
             cur = window.popleft()
             if k + depth < n:
-                window.append(self._fetch_device(k + depth))
-            self.bytes_streamed += self.blocks[k].nbytes
+                window.append(self._fetch_device(k + depth, col_ids))
+            blk = self.blocks[k]
+            self.bytes_streamed += (
+                blk.nbytes if col_ids is None
+                else blk.shape[0] * len(col_ids) * blk.itemsize)
             yield k * self.block_rows, cur
 
-    def gather_rows(self, idx: np.ndarray) -> np.ndarray:
+    def gather_rows(self, idx: np.ndarray, col_ids=None) -> np.ndarray:
         """Host-side row gather (GOSS-at-the-source: only the sampled rows
-        cross PCIe, so transferred bytes shrink with the sampling rate)."""
+        cross PCIe, so transferred bytes shrink with the sampling rate;
+        ``col_ids`` additionally restricts the gather to the active
+        columns — the r20 hot-feature prior compounding on top)."""
         idx = np.asarray(idx, np.int64)
-        out = np.empty((len(idx), self.num_features), self.dtype)
+        n_cols = self.num_features if col_ids is None else len(col_ids)
+        out = np.empty((len(idx), n_cols), self.dtype)
         b = idx // self.block_rows
         r = idx - b * self.block_rows
         for k in range(len(self.blocks)):
             m = b == k
             if m.any():
-                out[m] = self.blocks[k][r[m]]
+                rows = self.blocks[k][r[m]]
+                out[m] = rows if col_ids is None else rows[:, col_ids]
         return out
 
     @staticmethod
@@ -280,6 +296,57 @@ def shard_block_store(store: BlockStore, n_shards: int
         sh.prefetch_blocks = store.prefetch_blocks
         shards.append(sh)
     return shards
+
+
+class ColumnViewStore:
+    """A column-restricted VIEW of a BlockStore (r20 feature screening).
+
+    Wraps a store and a sorted global column-id vector; ``device_blocks``
+    and ``gather_rows`` yield ``[rows, F_active]`` slices (sliced on the
+    host, BEFORE device_put — the PCIe saving is real, not cosmetic),
+    while every other attribute — retry config, fault injector, device
+    pin, quarantine set, the ``bytes_streamed`` odometer — delegates to
+    the parent, so a view composes transparently with the streamed
+    round functions, ``shard_block_store`` shards, and
+    ``drain_shard_odometers`` (which must keep draining the REAL
+    shards).  Trees grown against a view live in compacted feature
+    space; the caller remaps winners to global ids
+    (``models.feature_mask.remap_split_features``).
+    """
+
+    def __init__(self, store, col_ids):
+        object.__setattr__(self, "_store", store)
+        object.__setattr__(
+            self, "col_ids", np.asarray(col_ids, np.int64))
+        if self.col_ids.ndim != 1 or len(self.col_ids) == 0:
+            raise ValueError("col_ids must be a non-empty 1-D id vector")
+        if self.col_ids.min() < 0 or \
+                self.col_ids.max() >= store.num_features:
+            raise ValueError(
+                f"col_ids out of range for a {store.num_features}-feature "
+                "store")
+
+    def __getattr__(self, name):
+        # anything not overridden (block_rows, num_blocks, padded_rows,
+        # num_rows, dtype, prefetch_blocks, device, quarantined, ...)
+        # reads through to the parent store
+        return getattr(self._store, name)
+
+    def __setattr__(self, name, value):
+        # writes (the GOSS rounds' ``bytes_streamed +=``, test knobs)
+        # also go to the parent — the view carries NO state of its own
+        setattr(self._store, name, value)
+
+    @property
+    def num_features(self) -> int:
+        return int(len(self.col_ids))
+
+    def device_blocks(self, prefetch_blocks: int = None):
+        return self._store.device_blocks(prefetch_blocks,
+                                         col_ids=self.col_ids)
+
+    def gather_rows(self, idx: np.ndarray) -> np.ndarray:
+        return self._store.gather_rows(idx, col_ids=self.col_ids)
 
 
 class _BlockWriter:

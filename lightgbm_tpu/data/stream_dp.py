@@ -43,6 +43,15 @@ structure and row partitions ``np.array_equal``, leaf values / preds to
 f32 rounding — and are FULLY bit-identical where every histogram sum is
 exact (single-round dyadic data pins this in tests/test_stream_dp.py).
 int8/bf16 wire is tolerance-gated, never bit-claimed.
+
+Feature screening (r20) stacks on BOTH byte reductions orthogonally:
+on screened rounds the Booster wraps each per-shard store in a
+:class:`~.block_store.ColumnViewStore` before handing it to the round
+drivers below, so PCIe ingest shrinks by ``F / F_active`` per shard
+(on top of GOSS's row sampling) and every per-block-round merge moves
+``F_active``-width histograms over the ring (on top of the wire
+dtype).  The drivers themselves are screening-blind — the view store
+and the compacted kernel shapes carry the whole change.
 """
 
 from __future__ import annotations

@@ -22,6 +22,15 @@ updates.  The host sampler is a deliberately different RNG stream from
 the device GOSS path (exact host top-k vs approx_top_mask), so GOSS
 under streaming is statistically equivalent but not bit-identical to
 in-memory GOSS — documented in README.
+
+Feature screening (r20) composes here for free: on screened rounds the
+Booster hands these drivers a
+:class:`~.block_store.ColumnViewStore` — the EMA screener acting as a
+hot-feature prior over the column axis, exactly dual to GOSS over the
+row axis — so every per-block gather, kernel, and odometer count below
+sees the compacted ``F_active`` width with no screened branch in this
+module.  Both F in the GOSS byte formula above and the per-block
+histograms shrink together.
 """
 
 from __future__ import annotations

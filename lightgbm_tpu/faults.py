@@ -107,6 +107,23 @@ class StreamScopeError(ValueError):
         self.key = key
 
 
+class ScreenScopeError(ValueError):
+    """A parameter gain-informed feature screening does not cover (r20).
+
+    Screened rounds grow trees in COMPACTED feature space and remap the
+    winners; configs whose static per-column state (categorical sets,
+    monotone signs, per-column bin counts, interaction groups, linear
+    leaf designs, the feature-sharded learner) is indexed by GLOBAL
+    column would train subtly differently, not merely slower — so the
+    fence is a hard typed error.  ``key`` names the exact offending
+    parameter, mirroring :class:`StreamScopeError`.
+    """
+
+    def __init__(self, message: str, key: str = ""):
+        super().__init__(message)
+        self.key = key
+
+
 class NonFiniteGradientError(RuntimeError):
     """Diagnostic raised by the training finiteness screen.
 

@@ -1,0 +1,183 @@
+"""Unified per-round feature masking + the EMA-FS gain screener (r20).
+
+Before this module, per-round feature masking lived in three separate
+code paths: the tree-level `feature_fraction` draw in ``models/gbdt.py``
+(host loop + fused-CV + the in-scan ``_multi_round_fn`` variant), the
+per-node `feature_fraction_bynode` closures duplicated inside both
+growers in ``models/tree.py``, and the EFB padding-mask concatenations
+on the fp/dp2 branches.  ISSUE 20 adds a fourth masker — gain-informed
+feature screening (EMA-FS, arXiv:2606.26337) — and folds all of them
+into THIS layer:
+
+* :func:`compose_tree_mask` — the single tree-level column sampler.
+  Screening (and any future masker) enters as ``base_mask``; the
+  fraction draw samples WITHIN it, so composition can never
+  double-mask or produce an empty usable set.
+* :func:`node_mask_fn` — the single per-node sampler factory, replacing
+  the two copies in ``grow_tree`` / ``grow_tree_frontier``.  Same fold
+  of the grower key with the node id, same ``base_mask`` nesting —
+  bit-identical to the closures it replaces.
+* :func:`pad_feature_mask` — the fp/dp2 width-padding concat, in one
+  place.
+* :class:`FeatureScreener` — per-feature gain EWMAs across rounds,
+  selecting a compacted active set per round with periodic full-refresh
+  rounds for exactness and cold-feature rediscovery.
+* :func:`remap_split_features` — the r9 ``_make_dist_scorer`` remap
+  idiom: trees grow in compacted ``[0, F_active)`` space and the winner
+  ids are gathered back to global feature ids before the tree is
+  appended, so predict / valid-eval / checkpoints never see compacted
+  ids.
+
+The screener itself is HOST-side numpy on purpose: it reads realized
+split gains once per round (the forest already syncs to host for the
+append bookkeeping) and its output — a static sorted id vector — keys
+the jit cache.  Exactly two program shapes exist per config: the full-F
+refresh round and the ``F_active`` screened round.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.sampling import sample_feature_mask
+
+
+def compose_tree_mask(key, fraction, num_features, base_mask=None):
+    """The per-TREE column mask: ``feature_fraction`` sampled WITHIN
+    ``base_mask`` (screening active set, or any other upstream mask).
+
+    Delegates to :func:`~lightgbm_tpu.ops.sampling.sample_feature_mask`
+    — the exact ops the pre-r20 call sites traced, so routing them
+    through here is bit-identical (``base_mask=None`` materializes the
+    same all-ones base the sampler always used).  All inputs may be
+    traced (the fused-CV path vmaps the fraction per config).
+    """
+    return sample_feature_mask(key, fraction, num_features,
+                               base_mask=base_mask)
+
+
+def node_mask_fn(key, ff_bynode, num_features: int, tree_mask,
+                 bynode_off: bool):
+    """Build the per-NODE column sampler both growers consume.
+
+    Per-node column subsample drawn WITHIN the per-tree subset (LightGBM
+    samples bynode from the tree-sampled set, so a node can never end up
+    with zero usable features).  When bynode sampling is statically off,
+    every node uses the tree mask directly — the threefry draw would be
+    ~20 wasted kernels per split iteration.  Under screening the tree
+    mask is already compacted, so bynode composes with the active set
+    for free — no second mask path.
+    """
+    def node_mask(node_id):
+        if bynode_off:
+            return tree_mask
+        return sample_feature_mask(jax.random.fold_in(key, node_id),
+                                   ff_bynode, num_features,
+                                   base_mask=tree_mask)
+
+    return node_mask
+
+
+def pad_feature_mask(mask, width: int):
+    """Zero-pad a feature mask to the learner's static column width (the
+    fp feature-shard width / dp2 column-mesh width).  Padding columns
+    carry mask 0, so padded features can never win a split."""
+    pad_cols = int(width) - int(mask.shape[0])
+    return (jnp.concatenate([mask, jnp.zeros(pad_cols, jnp.float32)])
+            if pad_cols else mask)
+
+
+def active_feature_count(num_features: int, keep_ratio: float) -> int:
+    """Static size of the screened active set: ``ceil(keep_ratio * F)``,
+    at least 1.  Static so the compile cache sees exactly one screened
+    program shape per config."""
+    return max(1, int(math.ceil(float(keep_ratio) * int(num_features))))
+
+
+def remap_split_features(tree, active_ids):
+    """Gather a compacted-space tree's winner ids back to GLOBAL feature
+    ids (the r9 ``_make_dist_scorer`` remap idiom, applied post-growth).
+    ``-1`` slots (unused node-table rows / leaves) pass through."""
+    ids = jnp.asarray(active_ids, jnp.int32)
+    sf = tree.split_feature
+    safe = jnp.clip(sf, 0, ids.shape[0] - 1)
+    return tree._replace(split_feature=jnp.where(sf >= 0, ids[safe], sf))
+
+
+class FeatureScreener:
+    """EMA-FS (arXiv:2606.26337): per-feature gain EWMAs -> per-round
+    active set.
+
+    Lifecycle per round: :meth:`plan` returns ``(active_ids, is_refresh)``
+    — ``active_ids`` is ``None`` on refresh rounds (grow over the FULL
+    feature set: round 0, every ``refresh_rounds`` rounds after, and any
+    round before the EWMA has seen a positive gain), otherwise a sorted
+    i32 id vector of the ``keep`` hottest features.  After the round,
+    :meth:`observe` folds the tree's realized split gains (GLOBAL ids —
+    call after :func:`remap_split_features`) into the EWMA.  Refresh
+    rounds observe too — that is exactly how a feature whose gain
+    appears late re-enters the active set.
+
+    State is two host values (the EWMA vector + the rounds-since-refresh
+    counter); both ride the r13 checkpoint so kill-anywhere resume
+    replans identical rounds.
+    """
+
+    def __init__(self, num_features: int, keep_ratio: float,
+                 ema_decay: float, refresh_rounds: int):
+        self.num_features = int(num_features)
+        self.keep = active_feature_count(num_features, keep_ratio)
+        self.ema_decay = float(ema_decay)
+        self.refresh_rounds = int(refresh_rounds)
+        self.ema = np.zeros(self.num_features, np.float32)
+        self.rounds_since_refresh = 0
+
+    @property
+    def screening(self) -> bool:
+        """Whether compaction can ever trigger (keep < F)."""
+        return self.keep < self.num_features
+
+    def plan(self) -> Tuple[Optional[np.ndarray], bool]:
+        """Active set for the NEXT round: ``(sorted_ids | None,
+        is_refresh)``."""
+        if (not self.screening or self.rounds_since_refresh == 0
+                or not np.any(self.ema > 0.0)):
+            return None, True
+        # stable arg-partition by descending EWMA: ties keep the lower
+        # feature id (deterministic regardless of numpy version), then
+        # sort ascending so the compacted layout preserves column order
+        hot = np.argsort(-self.ema, kind="stable")[:self.keep]
+        return np.sort(hot).astype(np.int32), False
+
+    def observe(self, split_feature: np.ndarray,
+                split_gain: np.ndarray) -> None:
+        """Fold one tree's realized split gains (global feature ids) into
+        the EWMA and advance the refresh counter."""
+        sf = np.asarray(split_feature).ravel()
+        sg = np.asarray(split_gain, np.float64).ravel()
+        gains = np.zeros(self.num_features, np.float64)
+        m = (sf >= 0) & (sf < self.num_features)
+        np.add.at(gains, sf[m].astype(np.int64), np.maximum(sg[m], 0.0))
+        d = self.ema_decay
+        self.ema = (d * self.ema + (1.0 - d) * gains).astype(np.float32)
+        self.rounds_since_refresh += 1
+        if self.rounds_since_refresh >= self.refresh_rounds:
+            self.rounds_since_refresh = 0   # next plan() is a refresh
+
+    # -- r13 checkpoint ride-along ---------------------------------------
+    def state(self) -> Tuple[np.ndarray, int]:
+        return self.ema.copy(), int(self.rounds_since_refresh)
+
+    def restore(self, ema: np.ndarray, rounds_since_refresh: int) -> None:
+        ema = np.asarray(ema, np.float32)
+        if ema.shape != (self.num_features,):
+            raise ValueError(
+                f"screener EWMA shape {ema.shape} does not match "
+                f"num_features={self.num_features}")
+        self.ema = ema.copy()
+        self.rounds_since_refresh = int(rounds_since_refresh)

@@ -67,7 +67,10 @@ class FusedCVResult(NamedTuple):
 
 
 from ..ops.sampling import sample_bag as _sample_bag
-from ..ops.sampling import sample_feature_mask as _sample_features_within
+# tree-level column sampling goes through the shared mask-composition
+# layer (models.feature_mask, r20) — same traced ops as the direct
+# sampler, so the fused-CV RNG stream is unchanged
+from .feature_mask import compose_tree_mask as _sample_features_within
 
 
 @functools.lru_cache(maxsize=None)

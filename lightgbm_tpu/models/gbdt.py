@@ -573,9 +573,9 @@ def _multi_round_fn(obj_key: tuple, num_leaves: int, num_bins: int,
                         bag_frac, num_data),
                     lambda _: bag, None)
             if use_ff:
-                from ..ops.sampling import sample_feature_mask
+                from .feature_mask import compose_tree_mask
 
-                fmask = sample_feature_mask(
+                fmask = compose_tree_mask(
                     jax.random.fold_in(ff_key, i), ff, num_features)
             else:
                 fmask = jnp.ones(num_features, jnp.float32)
@@ -713,12 +713,23 @@ def _bag_fn():
 
 
 @functools.lru_cache(maxsize=None)
-def _feature_mask_fn(num_features: int):
-    from ..ops.sampling import sample_feature_mask
+def _feature_mask_fn(num_features: int, with_base: bool = False):
+    from .feature_mask import compose_tree_mask
+
+    if with_base:
+        # screening composition (r20): feature_fraction samples WITHIN
+        # the screener's active-set mask, so the two maskers can never
+        # double-mask into an empty usable set
+        @jax.jit
+        def sample_features_within(key, fraction, base_mask):
+            return compose_tree_mask(key, fraction, num_features,
+                                     base_mask)
+
+        return sample_features_within
 
     @jax.jit
     def sample_features(key, fraction):
-        return sample_feature_mask(key, fraction, num_features)
+        return compose_tree_mask(key, fraction, num_features)
 
     return sample_features
 
@@ -926,6 +937,23 @@ class Booster:
         self._linear_k = None
         if p.linear_tree:
             self._setup_linear_tree()
+        # r20 gain-informed feature screening: the host-side EWMA
+        # screener plans a compacted active set per round (None on
+        # refresh rounds); a checkpoint restore that arrived before this
+        # setup re-applies its stashed EWMA state here
+        self._screener = None
+        self._screen_bins_cache = None
+        if p.feature_screen == "ema":
+            self._check_screen_scope()
+            from .feature_mask import FeatureScreener
+
+            self._screener = FeatureScreener(
+                int(ds.num_feature_), p.screen_keep_ratio,
+                p.screen_ema_decay, p.screen_refresh_rounds)
+            stash = getattr(self, "_screen_restore", None)
+            if stash is not None:
+                self._screener.restore(*stash)
+                self._screen_restore = None
         self._dp_mesh = None
         self._fp_mesh = None
         if self._streamed:
@@ -983,6 +1011,43 @@ class Booster:
         if bad is not None:
             raise StreamScopeError(
                 f"streamed (from_blocks) training does not support {bad} "
+                f"(unsupported key: {key})", key=key)
+
+    def _check_screen_scope(self) -> None:
+        """Feature screening covers the plain gbdt/rf/goss growers (the
+        serial, streamed, and data-parallel row meshes).  Configs whose
+        static per-column state is indexed by GLOBAL feature id —
+        categorical sets, monotone signs, interaction groups, per-column
+        bin counts (extra_trees), linear leaf designs, the
+        feature-sharded learner, DART's per-round replay — would need a
+        remap per structure to grow in compacted space; each fence
+        raises :class:`~lightgbm_tpu.faults.ScreenScopeError` naming the
+        exact offending key, mirroring ``_check_streamed_scope``."""
+        from ..faults import ScreenScopeError
+
+        p = self.params
+        bad = key = None
+        if self._num_class > 1:
+            bad, key = "multiclass objectives", "num_class"
+        elif getattr(self.obj, "needs_group", False):
+            bad, key = f"ranking objective '{self.obj.name}'", "objective"
+        elif p.linear_tree:
+            bad = key = "linear_tree"
+        elif p.boosting == "dart":
+            bad, key = "boosting='dart'", "boosting"
+        elif p.extra_trees:
+            bad = key = "extra_trees"
+        elif self._mono_key is not None:
+            bad = key = "monotone_constraints"
+        elif self._ic_key is not None:
+            bad = key = "interaction_constraints"
+        elif self._cat_key is not None:
+            bad, key = "categorical features", "categorical_feature"
+        elif p.tree_learner == "feature":
+            bad, key = "tree_learner='feature'", "tree_learner"
+        if bad is not None:
+            raise ScreenScopeError(
+                f"feature_screen='ema' does not support {bad} "
                 f"(unsupported key: {key})", key=key)
 
     def _resolve_monotone_constraints(self) -> Optional[tuple]:
@@ -1234,6 +1299,9 @@ class Booster:
                  and self._mono_key is None and self._ic_key is None
                  and self._cat_key is None and self._nbins_key is None
                  and p.feature_fraction_bynode >= 1.0
+                 and p.feature_screen == "off"  # screening compacts the
+                 # column axis per round; the 2-D mesh pins a static
+                 # column shard width — keep the 1-D row mesh instead
                  and p.extra.get("histogram_merge") is None
                  and p.extra.get("histogram_wire", "f32") == "f32")
         if spec == "auto":
@@ -1717,6 +1785,13 @@ class Booster:
             "parallel": parallel,
             "schema_digest": schema_digest(self.train_set.bin_mapper),
         }
+        if getattr(self, "_screener", None) is not None:
+            # r20: the EWMA vector + refresh counter ARE the screener's
+            # whole state — with them restored, plan() reproduces the
+            # identical active set every remaining round
+            ema, rounds_since = self._screener.state()
+            arrays["screen_ema"] = ema
+            meta["screen_rounds_since_refresh"] = rounds_since
         return arrays, meta
 
     def restore_checkpoint_state(self, arrays, meta) -> None:
@@ -1743,6 +1818,15 @@ class Booster:
         self._pred_train = jnp.asarray(arrays["pred_train"])
         self._bag = jnp.asarray(arrays["bag"])
         self._key = jnp.asarray(arrays["key"])
+        if "screen_ema" in arrays:
+            state = (np.asarray(arrays["screen_ema"], np.float32),
+                     int(meta.get("screen_rounds_since_refresh", 0)))
+            if getattr(self, "_screener", None) is not None:
+                self._screener.restore(*state)
+            else:
+                # restore arrived before _setup_training (continuation
+                # flows attach the Dataset later) — stash for it
+                self._screen_restore = state
         if getattr(self, "_dp_mesh", None) is not None and \
                 not getattr(self, "_dp_stats_only", False):
             # elastic resume (r19): the checkpoint gathered these to host
@@ -1754,12 +1838,28 @@ class Booster:
             self._pred_train, self._bag = shard_rows(
                 self._dp_mesh, self._pred_train, self._bag)
 
-    def _sample_bag_and_fmask(self, i: int):
+    def _screen_view(self, bins, active_ids):
+        """Compacted ``[N, F_active]`` gather of the binned matrix for a
+        screened round, cached on (matrix identity, active-id bytes) so
+        consecutive rounds with an unchanged active set reuse the device
+        gather instead of re-materializing it."""
+        ck = active_ids.tobytes()
+        c = self._screen_bins_cache
+        if c is not None and c[0] is bins and c[1] == ck:
+            return c[2]
+        out = jnp.take(bins, jnp.asarray(active_ids, jnp.int32), axis=1)
+        self._screen_bins_cache = (bins, ck, out)
+        return out
+
+    def _sample_bag_and_fmask(self, i: int, screen_ids=None):
         """Per-round stochasticity shared by plain and DART rounds: resample
         the bagging mask on schedule (updating ``self._bag``, kept
         mesh-sharded under DP) and return this round's feature mask.  RNG
         streams are keyed by round index so any round path reproduces the
-        same draws."""
+        same draws.  ``screen_ids`` (r20) threads the screener's active
+        set in as the BASE mask, so ``feature_fraction`` samples within
+        it — composition through the one mask layer, never a second
+        masking pass."""
         ds = self.train_set
         p = self.params
         if p.bagging_freq > 0 and p.bagging_fraction < 1.0 and \
@@ -1776,12 +1876,20 @@ class Booster:
                 self._bag = shard_rows(self._dp_mesh, self._bag)
         n_cols = int(ds.num_feature_)  # == X_binned.shape[1]; X_binned is
         # None under streaming (the codes live in ds.block_store)
+        base = None
+        if screen_ids is not None:
+            bm = np.zeros(n_cols, np.float32)
+            bm[screen_ids] = 1.0
+            base = jnp.asarray(bm)
         if p.feature_fraction < 1.0:
             fkey = jax.random.fold_in(
                 jax.random.PRNGKey(p.feature_fraction_seed + p.seed), i)
+            if base is not None:
+                return _feature_mask_fn(n_cols, True)(
+                    fkey, jnp.float32(p.feature_fraction), base)
             return _feature_mask_fn(n_cols)(
                 fkey, jnp.float32(p.feature_fraction))
-        return jnp.ones(n_cols, jnp.float32)
+        return base if base is not None else jnp.ones(n_cols, jnp.float32)
 
     # -- round step ------------------------------------------------------
     def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
@@ -1804,7 +1912,16 @@ class Booster:
         p = self.params
         i = self._iter
 
-        fmask = self._sample_bag_and_fmask(i)
+        screener = getattr(self, "_screener", None)
+        active_ids = None
+        if screener is not None:
+            active_ids, _ = screener.plan()   # None on refresh rounds
+        fmask = self._sample_bag_and_fmask(i, screen_ids=active_ids)
+        if active_ids is not None:
+            # screened round: compact the mask to [F_active] — bins and
+            # comms compact below per branch; exactly two program shapes
+            # per config (full F on refresh rounds, F_active otherwise)
+            fmask = jnp.take(fmask, jnp.asarray(active_ids, jnp.int32))
 
         goss_k = None
         eff_rows = int(ds.row_mask.shape[0])
@@ -1830,6 +1947,14 @@ class Booster:
             hist_impl = p.extra.get("hist_impl", "auto")
             hist_dtype = resolve_hist_dtype(p, eff_rows)
             wave_width = resolve_wave_width(p, eff_rows)
+            store = ds.block_store
+            if active_ids is not None:
+                # screened round out-of-core: only the active columns
+                # cross PCIe (the screener doubling as the hot-feature
+                # prior for GOSS-at-the-source row gathers)
+                from ..data.block_store import ColumnViewStore
+
+                store = ColumnViewStore(store, active_ids)
             if getattr(self, "_stream_dp", False):
                 # r19: streamed × dp — per-shard stores, per-block-round
                 # merges; GOSS samples per shard at the source
@@ -1840,12 +1965,18 @@ class Booster:
                 merge_mode, _ = self._dp_merge_mode()
                 wire_dtype, merge_chunks = self._dp_wire(
                     merge_mode, eff_rows)
+                shards = self._stream_shards
+                if active_ids is not None:
+                    from ..data.block_store import ColumnViewStore
+
+                    shards = [ColumnViewStore(sh, active_ids)
+                              for sh in shards]
                 if goss_k is not None:
                     n_sh = len(self._stream_shards)
                     goss_k_shard = (max(goss_k[0] // n_sh, 1),
                                     max(goss_k[1] // n_sh, 1))
                     tree, new_pred = stream_dp_goss_round(
-                        self._stream_shards, self._dp_mesh,
+                        shards, self._dp_mesh,
                         self._obj_key, self._dp_y, self._dp_w,
                         self._bag, self._pred_train, fmask, self._hyper,
                         round_key, goss_k_shard, float(p.top_rate),
@@ -1855,7 +1986,7 @@ class Booster:
                         merge_chunks)
                 else:
                     tree, new_pred = stream_dp_plain_round(
-                        self._stream_shards, self._dp_mesh,
+                        shards, self._dp_mesh,
                         self._obj_key, self._dp_y, self._dp_w,
                         self._bag, self._pred_train, fmask, self._hyper,
                         p.num_leaves, self._num_bins, hist_impl,
@@ -1865,7 +1996,7 @@ class Booster:
                                       self._stream_shards)
             elif goss_k is not None:
                 tree, new_pred = stream_goss_round(
-                    ds.block_store, self._obj_key, ds.y, self._w_eff,
+                    store, self._obj_key, ds.y, self._w_eff,
                     self._bag, self._pred_train, fmask, self._hyper,
                     round_key, goss_k, float(p.top_rate),
                     float(p.other_rate), p.seed * 1_000_003 + i,
@@ -1873,7 +2004,7 @@ class Booster:
                     wave_width, renew_alpha, renew_scale)
             else:
                 tree, new_pred = stream_plain_round(
-                    ds.block_store, self._obj_key, ds.y, self._w_eff,
+                    store, self._obj_key, ds.y, self._w_eff,
                     self._bag, self._pred_train, fmask, self._hyper,
                     p.num_leaves, self._num_bins, hist_impl, hist_dtype,
                     wave_width, p.boosting == "rf", renew_alpha,
@@ -1887,10 +2018,9 @@ class Booster:
                 int(p.extra.get("row_chunk", 131072)), p.boosting == "rf",
                 resolve_hist_dtype(p, eff_rows), self._num_class,
                 self._cat_key, resolve_wave_width(p, eff_rows))
-            pad_cols = self._fp_width - int(fmask.shape[0])
-            fmask_p = jnp.concatenate(
-                [fmask, jnp.zeros(pad_cols, jnp.float32)]) \
-                if pad_cols else fmask
+            from .feature_mask import pad_feature_mask
+
+            fmask_p = pad_feature_mask(fmask, self._fp_width)
             tree, new_pred = fn(self._fp_bins, ds.y, self._w_eff, self._bag,
                                 self._pred_train, fmask_p, self._hyper,
                                 round_key)
@@ -1906,10 +2036,9 @@ class Booster:
                 int(p.extra.get("row_chunk", 131072)), p.boosting == "rf",
                 resolve_hist_dtype(p, eff_rows),
                 resolve_wave_width(p, eff_rows))
-            pad_cols = self._dp2_width - int(fmask.shape[0])
-            fmask_p = jnp.concatenate(
-                [fmask, jnp.zeros(pad_cols, jnp.float32)]) \
-                if pad_cols else fmask
+            from .feature_mask import pad_feature_mask
+
+            fmask_p = pad_feature_mask(fmask, self._dp2_width)
             tree, new_pred = fn(self._dp_bins, self._dp_y, self._dp_w,
                                 self._bag, self._pred_train, fmask_p,
                                 self._hyper, round_key)
@@ -1932,7 +2061,9 @@ class Booster:
                 resolve_wave_width(p, eff_rows),
                 resolve_hist_dtype(p, eff_rows),
                 merge_mode, voting_k, wire_dtype, merge_chunks)
-            tree, row_leaf = fn(self._dp_bins, stats, fmask, self._hyper,
+            dp_bins = (self._dp_bins if active_ids is None
+                       else self._screen_view(self._dp_bins, active_ids))
+            tree, row_leaf = fn(dp_bins, stats, fmask, self._hyper,
                                 round_key)
             new_pred = self._pred_train + jnp.float32(p.learning_rate) \
                 * lookup_values(row_leaf, tree.leaf_value)
@@ -1976,7 +2107,9 @@ class Booster:
                 self._mono_key, p.extra_trees, self._nbins_key,
                 self._num_class, self._ic_key, self._cat_key,
                 merge_mode, voting_k, wire_dtype, merge_chunks)
-            tree, new_pred = fn(self._dp_bins, self._dp_y, self._dp_w,
+            dp_bins = (self._dp_bins if active_ids is None
+                       else self._screen_view(self._dp_bins, active_ids))
+            tree, new_pred = fn(dp_bins, self._dp_y, self._dp_w,
                                 self._bag, self._pred_train, fmask,
                                 self._hyper, round_key)
         else:
@@ -1994,9 +2127,23 @@ class Booster:
                                     self._bag, self._pred_train, fmask,
                                     self._hyper, round_key, self._xraw)
             else:
-                tree, new_pred = fn(ds.X_binned, ds.y, self._w_eff,
+                bins = (ds.X_binned if active_ids is None
+                        else self._screen_view(ds.X_binned, active_ids))
+                tree, new_pred = fn(bins, ds.y, self._w_eff,
                                     self._bag, self._pred_train, fmask,
                                     self._hyper, round_key)
+        if active_ids is not None:
+            # the tree grew in compacted space — gather the winner ids
+            # back to GLOBAL features before anything downstream
+            # (predict, valid eval, checkpoints, the screener) sees it
+            from .feature_mask import remap_split_features
+
+            tree = remap_split_features(tree, active_ids)
+        if screener is not None:
+            # refresh rounds observe too — that is exactly how a feature
+            # whose gain appears late re-enters the active set
+            screener.observe(np.asarray(tree.split_feature),
+                             np.asarray(tree.split_gain))
         if p.boosting != "rf":
             self._pred_train = new_pred
         if p.boosting != "rf" and p.learning_rate != self._base_lr:
@@ -2036,6 +2183,7 @@ class Booster:
                 and not getattr(self, "_streamed", False)
                 and p.boosting in ("gbdt", "rf", "goss")
                 and not p.linear_tree
+                and p.feature_screen == "off"  # screener plans per round
                 and not self._valid)
 
     def update_many(self, k: int) -> None:

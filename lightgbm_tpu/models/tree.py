@@ -717,19 +717,13 @@ def grow_tree(
                and not extra_trees and ic_member is None and bynode_off
                and fp_axis is None and not dist_mode)
 
-    def node_feature_mask(node_id):
-        """Per-node column subsample drawn WITHIN the per-tree subset
-        (LightGBM samples bynode from the tree-sampled set, so a node can
-        never end up with zero usable features).  When bynode sampling is
-        statically off, every node uses the tree mask directly — the
-        threefry draw would be ~20 wasted kernels per split iteration."""
-        if bynode_off:
-            return feature_mask
-        from ..ops.sampling import sample_feature_mask
+    # per-node column subsample: the ONE shared mask-composition layer
+    # (models.feature_mask, r20) — bynode draws WITHIN the tree mask,
+    # which under screening is already compacted to the active set
+    from .feature_mask import node_mask_fn
 
-        return sample_feature_mask(jax.random.fold_in(key, node_id),
-                                   ff_bynode, num_features,
-                                   base_mask=feature_mask)
+    node_feature_mask = node_mask_fn(key, ff_bynode, num_features,
+                                     feature_mask, bynode_off)
 
     def node_rand_bins(node_id):
         if not extra_trees:
@@ -1286,14 +1280,12 @@ def grow_tree_frontier(
     else:
         f_hist = num_features
 
-    def node_feature_mask(node_id):
-        if bynode_off:
-            return feature_mask
-        from ..ops.sampling import sample_feature_mask
+    # shared mask-composition layer (models.feature_mask, r20): same
+    # fold_in(key, node_id)-within-tree-mask draw as the strict grower
+    from .feature_mask import node_mask_fn
 
-        return sample_feature_mask(jax.random.fold_in(key, node_id),
-                                   ff_bynode, num_features,
-                                   base_mask=feature_mask)
+    node_feature_mask = node_mask_fn(key, ff_bynode, num_features,
+                                     feature_mask, bynode_off)
 
     def node_rand_bins(node_id):
         if not extra_trees:

@@ -18,6 +18,11 @@ chunked so peak memory stays bounded for multi-million-row data.
 A Pallas kernel with the same signature (one-hot built tile-by-tile in VMEM,
 never materialized in HBM) lives in ``histogram_pallas.py`` and is selected
 via ``ops.histogram.compute_histograms(..., impl=...)``.
+
+The feature axis F here is the CALLER's column space: under r20 feature
+screening the grower passes a gathered ``[N, F_active]`` bin view, so the
+scan length, the merge payloads below, and the per-chunk one-hot work all
+shrink to the active set with no screening logic in this module.
 """
 
 from __future__ import annotations

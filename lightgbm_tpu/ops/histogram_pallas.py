@@ -15,6 +15,11 @@ HBM:
 
 HBM traffic drops from O(n*B) (materialized one-hot) to O(n*(F + K*S)) —
 the data is read once.
+
+F is the caller's column space: r20 feature screening hands this kernel a
+compacted ``[N, F_active]`` view, shrinking both the VMEM-resident
+``[F, B, K*S]`` accumulator and the per-tile contraction work; exactly two
+program shapes exist per config (full F and the static F_active).
 """
 
 from __future__ import annotations

@@ -189,6 +189,17 @@ _SLOW_TESTS = {
     "test_multiclass_contrib_shape",
     "test_dp_multiclass_goss_trains",
     "test_staged_prediction_prefix_consistency",
+    # third tier (r20: the fast lane crept to 99.6% of the 870 s verify
+    # budget — 866.61 s measured 2026-08-08 — so the heaviest parity
+    # tests move here; check.sh's tier2-heavy lane still runs every one
+    # of them by node id on each CI pass)
+    "test_fp_wave_growth_matches_serial",            # 27.0 s
+    "test_mesh_shape_routing",                       # 19.8 s
+    "test_daemon_retunes_every_n_flips",             # 15.8 s
+    "test_fused_cv_multiclass_matches_host_loop",    # 15.1 s
+    "test_histogram_wire_override_param",            # 14.7 s
+    "test_screened_in_memory_matches_streamed",      # 10.5 s (both params)
+    "test_screened_stream_moves_fewer_bytes",        #  4.6 s
 }
 
 

@@ -160,6 +160,39 @@ def test_sigterm_drains_checkpoints_and_resumes(tmp_path):
     _assert_same_run(ref, res2.booster)
 
 
+def test_screened_kill_resume_bit_identical(tmp_path):
+    """r20: the EMA screener's state (EWMA vector + rounds-since-refresh
+    counter) rides the checkpoint, so a kill mid-screening-cycle resumes
+    to the SAME active-set plans and the same forest bit for bit."""
+    X, y = _problem(n=900, f=13, seed=4)
+    p = dict(objective="binary", num_leaves=15, learning_rate=0.2,
+             max_bin=31, min_data_in_leaf=5, verbose=-1, seed=7,
+             feature_screen="ema", screen_keep_ratio=0.3,
+             screen_refresh_rounds=3)
+
+    def make_ds():
+        return Dataset(X, label=y, params=dict(p))
+
+    rounds = 8
+    ref = _reference(p, make_ds, rounds)
+    d = str(tmp_path / "ckpts")
+    b = lgb.Booster(dict(p), make_ds())
+    for _ in range(5):                       # kill between refreshes
+        b.update()
+    save_checkpoint(b, d)
+    ema5, since5 = b._screener.state()
+    assert since5 != 0                       # genuinely mid-cycle
+
+    r = resume_booster(latest_checkpoint(d), make_ds())
+    got_ema, got_since = r._screener.state()
+    assert np.array_equal(got_ema, ema5) and got_since == since5
+    for _ in range(rounds - 5):
+        r.update()
+    _assert_same_run(ref, r)
+    assert np.array_equal(r._screener.state()[0],
+                          ref._screener.state()[0])
+
+
 def test_dp_mesh_resume_bit_identical(tmp_path):
     """Dryrun multi-chip (8 virtual CPU devices): the checkpoint carries
     the merge-mode config and resume stays bit-identical."""

@@ -431,11 +431,16 @@ def test_staleness_model_and_budgets_green():
         assert key in m
     assert m["staleness_s"] > m["train_s"] > 0
     res = check_freshness_budgets()
-    assert len(res) == len(FRESHNESS_BUDGETS) == 5
+    assert len(res) == len(FRESHNESS_BUDGETS) == 6
     assert all(r["ok"] for r in res)
     names = {r["name"] for r in res}
     assert {"freshness_slo_ref", "freshness_train_warm_canary_ref",
-            "freshness_cold_retrain_blows_slo"} <= names
+            "freshness_cold_retrain_blows_slo",
+            "freshness_screen_train_leg"} <= names
+    # the r20 screened leg reports the factor it applied to the train leg
+    screened = next(r for r in res
+                    if r["name"] == "freshness_screen_train_leg")
+    assert 0.0 < screened["screen_round_factor"] < 1.0
     # the guard-the-model bar: a cold retrain MUST blow the SLO
     cold = freshness_budget_by_name("freshness_cold_retrain_blows_slo")
     assert cold.cmp == "ge" and cold.check()["ok"]

@@ -165,3 +165,36 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_sweep.py -q \
 echo "== streamed-dp (dp-mesh streaming + elastic resume) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_stream_dp.py -q \
   -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# 12. screening: the r20 perf surface — EMA-FS gain-informed feature
+#     screening through the unified mask layer: screen-off bit-identity
+#     (strict/wave, in-memory/streamed, F up to 136), screened
+#     in-memory == streamed parity with the compacted ColumnViewStore
+#     (PCIe odometer drop measured), composition with
+#     feature_fraction / bynode / EFB without double-masking, refresh
+#     rediscovery of late-gain features, screened kill/resume via the
+#     r13 checkpoint, global-id remap sentinels, and the typed
+#     ScreenScopeError fences.  The SCREEN budget lines + anchors
+#     already ran in the lint layer above (screen / budget_anchors /
+#     launch_budgets sections).
+echo "== screening (EMA-FS feature screening) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_screening.py -q \
+  -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# 13. tier2-heavy: the parity tests moved out of the fast lane when it
+#     crept to 99.6% of the 870 s tier-1 budget (conftest._SLOW_TESTS
+#     third tier, r20).  Run by node id with the marker filter cleared
+#     so the move never silently drops coverage: feature-parallel wave
+#     growth vs serial, dp mesh-shape routing, the sweep daemon's
+#     retune-every-N loop, and the screened in-memory == streamed
+#     parity pair (ColumnViewStore PCIe odometer included).
+echo "== tier2-heavy (slow-lane parity tests, run in full) =="
+JAX_PLATFORMS=cpu python -m pytest \
+  "tests/test_parallel.py::test_fp_wave_growth_matches_serial" \
+  "tests/test_merge_modes.py::test_mesh_shape_routing" \
+  "tests/test_merge_modes.py::test_histogram_wire_override_param" \
+  "tests/test_round4_fixes.py::test_fused_cv_multiclass_matches_host_loop" \
+  "tests/test_sweep.py::test_daemon_retunes_every_n_flips" \
+  "tests/test_screening.py::test_screened_in_memory_matches_streamed" \
+  "tests/test_screening.py::test_screened_stream_moves_fewer_bytes" \
+  -q -m '' -p no:cacheprovider -p no:xdist -p no:randomly
